@@ -1,0 +1,118 @@
+"""Central flag table, env-overridable per-process.
+
+The reference materializes 200+ flags from an X-macro table
+(src/ray/common/ray_config_def.h via RayConfig, src/ray/common/ray_config.h:60)
+with env override ``RAY_<name>``. We keep the same shape in Python: a single
+declarative table, every entry overridable via ``RAY_TPU_<NAME>``, snapshotted
+once per process and shippable to spawned processes.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, fields
+
+
+# dataclasses stores string annotations; resolve the primitive type for env
+# parsing without importing typing machinery.
+def _resolve_type(t):
+    mapping = {"int": int, "float": float, "bool": bool, "str": str}
+    return mapping.get(t, str) if isinstance(t, str) else t
+
+
+def _env(name: str, default, typ):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return typ(raw)
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    # Max object size stored inline in the in-process memory store / RPC
+    # messages instead of the shared-memory store (reference inlines ~100KB:
+    # ray_config_def.h max_direct_call_object_size).
+    max_inline_object_size: int = 100 * 1024
+    # Shared-memory arena size per node. 0 = auto (30% of /dev/shm free).
+    object_store_memory: int = 0
+    # Chunk size for node-to-node object transfer (reference: 5 MiB,
+    # ray_config_def.h:333 object_manager_default_chunk_size).
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+    # Directory for shm arena files.
+    shm_dir: str = "/dev/shm"
+    # Spill directory for objects evicted under memory pressure.
+    spill_dir: str = "/tmp/ray_tpu/spill"
+    enable_spill: bool = True
+
+    # --- scheduling ---
+    # Hybrid policy: pack onto nodes until utilization crosses this threshold,
+    # then spread (reference: scheduler_spread_threshold, hybrid policy
+    # src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50).
+    scheduler_spread_threshold: float = 0.5
+    # Top-k fraction of best nodes to randomize among.
+    scheduler_top_k_fraction: float = 0.2
+    # Worker lease timeout (s).
+    lease_timeout_s: float = 30.0
+    # Max workers to keep pre-started per node (0 = num_cpus).
+    prestart_workers: int = 0
+    worker_register_timeout_s: float = 30.0
+
+    # --- fault tolerance ---
+    default_task_max_retries: int = 3
+    default_actor_max_restarts: int = 0
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    # lineage reconstruction
+    enable_lineage_reconstruction: bool = True
+    max_lineage_bytes: int = 256 * 1024 * 1024
+
+    # --- RPC / protocol ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_retry_delay_s: float = 0.1
+    rpc_max_retries: int = 5
+    # Failure-injection spec: "method:prob,method:prob" (reference:
+    # RAY_testing_rpc_failure, src/ray/rpc/rpc_chaos.cc:33).
+    testing_rpc_failure: str = ""
+
+    # --- logging / metrics ---
+    log_dir: str = ""
+    log_to_driver: bool = True
+    event_stats: bool = False
+    metrics_report_interval_s: float = 5.0
+    task_events_max_buffer_size: int = 10000
+
+    # --- misc ---
+    session_dir_root: str = "/tmp/ray_tpu"
+    gcs_port: int = 0  # 0 = pick free port
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env(f.name, getattr(self, f.name), _resolve_type(f.type)))
+
+    def to_json(self) -> str:
+        return json.dumps({f.name: getattr(self, f.name) for f in fields(self)})
+
+    @classmethod
+    def from_json(cls, data: str) -> "Config":
+        cfg = cls.__new__(cls)
+        for k, v in json.loads(data).items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_config(cfg: Config):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,143 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes, placement groups.
+
+Design follows the reference's embedded-ownership ID scheme
+(reference: src/ray/common/id.h, design_docs/id_specification.md) but is
+simplified: every ID is a fixed-length random byte string, with ObjectIDs
+embedding the TaskID that produced them plus a return-index, and TaskIDs
+embedding the JobID. This lets any holder of an ObjectID derive its owning
+task (and hence its owner process) without a directory lookup -- the basis of
+owner-based object management and lineage reconstruction.
+"""
+from __future__ import annotations
+
+import os
+
+
+_JOB_ID_LEN = 4
+_UNIQUE_LEN = 12  # random part of a TaskID
+_TASK_ID_LEN = _JOB_ID_LEN + _UNIQUE_LEN  # 16
+_OBJECT_INDEX_LEN = 4
+_OBJECT_ID_LEN = _TASK_ID_LEN + _OBJECT_INDEX_LEN  # 20
+
+
+class BaseID:
+    __slots__ = ("_bytes", "_hash")
+    LENGTH = 0
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.LENGTH:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.LENGTH} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = id_bytes
+        self._hash = hash(id_bytes)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.LENGTH))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.LENGTH)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.LENGTH
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    LENGTH = _JOB_ID_LEN
+
+    @classmethod
+    def from_int(cls, value: int):
+        return cls(value.to_bytes(cls.LENGTH, "little"))
+
+    def to_int(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+
+class NodeID(BaseID):
+    LENGTH = 16
+
+
+class WorkerID(BaseID):
+    LENGTH = 16
+
+
+class ActorID(BaseID):
+    LENGTH = 16
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(job_id.binary() + os.urandom(cls.LENGTH - _JOB_ID_LEN))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:_JOB_ID_LEN])
+
+
+class TaskID(BaseID):
+    LENGTH = _TASK_ID_LEN
+
+    @classmethod
+    def for_job(cls, job_id: JobID):
+        return cls(job_id.binary() + os.urandom(_UNIQUE_LEN))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:_JOB_ID_LEN])
+
+
+class ObjectID(BaseID):
+    """ObjectID = TaskID of the creating task + little-endian return index.
+
+    Objects created by ``put`` use a dedicated synthetic "put task" id per
+    worker, mirroring the reference's put-index scheme (src/ray/common/id.h).
+    """
+
+    LENGTH = _OBJECT_ID_LEN
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int):
+        return cls(task_id.binary() + index.to_bytes(_OBJECT_INDEX_LEN, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_TASK_ID_LEN])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[_TASK_ID_LEN:], "little")
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+
+class PlacementGroupID(BaseID):
+    LENGTH = 16
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(job_id.binary() + os.urandom(cls.LENGTH - _JOB_ID_LEN))
+
+

@@ -1,0 +1,214 @@
+"""Python client for the native node-local shared-memory object store.
+
+Each process on a node opens the same arena file (created by the raylet) and
+talks to it through ctypes calls into libshmstore.so -- no store server, no
+socket round-trips (contrast: reference plasma client,
+src/ray/object_manager/plasma/client.cc, which RPCs a store process and
+passes fds). Reads are zero-copy memoryviews over the shared mapping.
+"""
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import Any, List, Optional, Tuple
+
+from ..native.build import ensure_built
+from .ids import ObjectID
+from . import serialization
+
+_ID_LEN = 20
+
+
+class _Lib:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            lib = ctypes.CDLL(ensure_built())
+            lib.shm_store_open.restype = ctypes.c_void_p
+            lib.shm_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int]
+            lib.shm_store_close.argtypes = [ctypes.c_void_p]
+            lib.shm_store_create.restype = ctypes.c_int
+            lib.shm_store_create.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.shm_store_seal.restype = ctypes.c_int
+            lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_get.restype = ctypes.c_int
+            lib.shm_store_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.shm_store_release.restype = ctypes.c_int
+            lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_contains.restype = ctypes.c_int
+            lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_delete.restype = ctypes.c_int
+            lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.shm_store_evict.restype = ctypes.c_uint64
+            lib.shm_store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.shm_store_reconcile.restype = ctypes.c_int
+            lib.shm_store_reconcile.argtypes = [ctypes.c_void_p]
+            lib.shm_store_stats.argtypes = [ctypes.c_void_p] + [
+                ctypes.POINTER(ctypes.c_uint64)
+            ] * 4
+            lib.shm_store_list.restype = ctypes.c_uint64
+            lib.shm_store_list.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64
+            ]
+            cls._instance = lib
+        return cls._instance
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectExistsError(Exception):
+    pass
+
+
+class ShmClient:
+    """Per-process handle to a node's shm arena."""
+
+    def __init__(self, arena_path: str, capacity: int = 0, create: bool = False):
+        self._lib = _Lib()
+        self._handle = self._lib.shm_store_open(
+            arena_path.encode(), ctypes.c_uint64(capacity), 1 if create else 0
+        )
+        if not self._handle:
+            raise RuntimeError(f"failed to open shm arena {arena_path}")
+        self.path = arena_path
+        fd = os.open(arena_path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            self._mmap = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._mmap)
+
+    # --- raw buffer API -------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        off = ctypes.c_uint64()
+        rc = self._lib.shm_store_create(
+            self._handle, object_id.binary(), ctypes.c_uint64(size), ctypes.byref(off)
+        )
+        if rc == -1:
+            raise ObjectExistsError(object_id.hex())
+        if rc in (-2, -3):
+            raise ObjectStoreFullError(f"cannot allocate {size} bytes (rc={rc})")
+        return self._view[off.value : off.value + size]
+
+    def seal(self, object_id: ObjectID) -> None:
+        rc = self._lib.shm_store_seal(self._handle, object_id.binary())
+        if rc != 0:
+            raise KeyError(f"seal failed for {object_id.hex()}")
+
+    def get_buffer(
+        self, object_id: ObjectID, timeout_ms: int = 0
+    ) -> Optional[memoryview]:
+        """Returns a zero-copy view (takes a ref; call release when done)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.shm_store_get(
+            self._handle, object_id.binary(), ctypes.c_int64(timeout_ms),
+            ctypes.byref(off), ctypes.byref(size),
+        )
+        if rc != 0:
+            return None
+        return self._view[off.value : off.value + size.value]
+
+    def release(self, object_id: ObjectID) -> None:
+        self._lib.shm_store_release(self._handle, object_id.binary())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.shm_store_contains(self._handle, object_id.binary()))
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._lib.shm_store_delete(self._handle, object_id.binary())
+
+    def evict(self, nbytes: int) -> int:
+        return int(self._lib.shm_store_evict(self._handle, ctypes.c_uint64(nbytes)))
+
+    def reconcile(self) -> int:
+        """Drop refs held by dead processes (raylet calls this periodically)."""
+        return int(self._lib.shm_store_reconcile(self._handle))
+
+    def stats(self) -> dict:
+        used = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        num = ctypes.c_uint64()
+        ev = ctypes.c_uint64()
+        self._lib.shm_store_stats(
+            self._handle, ctypes.byref(used), ctypes.byref(cap),
+            ctypes.byref(num), ctypes.byref(ev),
+        )
+        return {
+            "used_bytes": used.value,
+            "capacity_bytes": cap.value,
+            "num_objects": num.value,
+            "num_evictions": ev.value,
+        }
+
+    def list_objects(self, max_ids: int = 1 << 16) -> List[ObjectID]:
+        buf = ctypes.create_string_buffer(max_ids * _ID_LEN)
+        n = self._lib.shm_store_list(self._handle, buf, ctypes.c_uint64(max_ids))
+        raw = buf.raw
+        return [
+            ObjectID(raw[i * _ID_LEN : (i + 1) * _ID_LEN]) for i in range(int(n))
+        ]
+
+    # --- object API -----------------------------------------------------
+    def put(self, object_id: ObjectID, value: Any) -> int:
+        """Serialize ``value`` directly into the store. Returns stored size."""
+        meta, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(meta, buffers)
+        view = self.create(object_id, size)
+        try:
+            serialization.write_into(view, meta, buffers)
+        except BaseException:
+            view.release()
+            self.delete(object_id)  # abort: don't leave a zombie unsealed entry
+            raise
+        view.release()
+        self.seal(object_id)
+        return size
+
+    def put_raw(self, object_id: ObjectID, data: bytes) -> None:
+        view = self.create(object_id, len(data))
+        try:
+            view[:] = data
+        finally:
+            view.release()
+        self.seal(object_id)
+
+    def get(self, object_id: ObjectID, timeout_ms: int = 0):
+        """Deserialize an object (zero-copy for large buffers).
+
+        The returned object may hold views into the arena; we intentionally
+        keep the read ref until `delete` is requested, reconciled by the
+        raylet's reference counting (releasing on deserialize would let the
+        LRU evict pages under live numpy views).
+        """
+        view = self.get_buffer(object_id, timeout_ms)
+        if view is None:
+            raise KeyError(object_id.hex())
+        return serialization.loads_from(view)
+
+    def close(self):
+        if self._handle:
+            try:
+                self._view.release()
+                self._mmap.close()
+            except BufferError:
+                pass  # zero-copy views still alive; OS reclaims at process exit
+            self._lib.shm_store_close(self._handle)
+            self._handle = None
+
+
+def default_arena_size(shm_dir: str = "/dev/shm") -> int:
+    st = os.statvfs(shm_dir)
+    free = st.f_bavail * st.f_frsize
+    return max(64 * 1024 * 1024, int(free * 0.3))

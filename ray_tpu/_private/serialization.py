@@ -1,0 +1,109 @@
+"""Serialization: cloudpickle + pickle5 out-of-band buffers for zero-copy.
+
+Mirrors the reference's split (python/ray/_private/serialization.py +
+vendored cloudpickle): metadata is pickled with cloudpickle (so lambdas,
+closures, and dynamically-defined classes work), while large contiguous
+buffers (numpy arrays, arrow buffers, bytes) travel out-of-band and are
+written directly into the shared-memory object store. Deserializing from a
+memoryview over the store mapping yields zero-copy (read-only) numpy arrays,
+like plasma's zero-copy reads (src/ray/object_manager/plasma/client.cc).
+
+Wire format of a sealed object:
+    [8 bytes: meta_len][meta (cloudpickle bytes)]
+    [8 bytes: nbuf][for each buffer: 8-byte len][buffer bytes (8-aligned)]
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_HEADER = struct.Struct("<Q")
+_ALIGN = 64  # align out-of-band buffers for vectorized consumers
+
+# Buffers smaller than this are kept in-band (copying is cheaper than the
+# bookkeeping).
+_OOB_THRESHOLD = 4096
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    buffers: List[pickle.PickleBuffer] = []
+
+    def buffer_callback(pb: pickle.PickleBuffer):
+        view = pb.raw()
+        if view.nbytes < _OOB_THRESHOLD:
+            return True  # serialize in-band
+        buffers.append(pb)
+        return False
+
+    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=buffer_callback)
+    return meta, buffers
+
+
+def serialized_size(meta: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    total = _HEADER.size + len(meta) + _HEADER.size
+    for pb in buffers:
+        total = _align(total + _HEADER.size) + pb.raw().nbytes
+    return _align(total)
+
+
+def write_into(view: memoryview, meta: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    """Write the wire format into ``view``; returns bytes written."""
+    off = 0
+    view[off : off + _HEADER.size] = _HEADER.pack(len(meta))
+    off += _HEADER.size
+    view[off : off + len(meta)] = meta
+    off += len(meta)
+    view[off : off + _HEADER.size] = _HEADER.pack(len(buffers))
+    off += _HEADER.size
+    for pb in buffers:
+        raw = pb.raw()
+        if not raw.contiguous:
+            raw = memoryview(raw.tobytes())
+        hdr_at = off
+        off = _align(off + _HEADER.size)
+        view[hdr_at : hdr_at + _HEADER.size] = _HEADER.pack(
+            ((off - hdr_at - _HEADER.size) << 48) | raw.nbytes
+        )
+        view[off : off + raw.nbytes] = raw.cast("B")
+        off += raw.nbytes
+    return off
+
+
+def dumps(obj: Any) -> bytes:
+    """One-shot serialize to a single bytes object (for RPC inlining)."""
+    meta, buffers = serialize(obj)
+    size = serialized_size(meta, buffers)
+    out = bytearray(size)
+    write_into(memoryview(out), meta, buffers)
+    return bytes(out)
+
+
+def loads_from(view: memoryview) -> Any:
+    """Deserialize from a (possibly shm-backed) memoryview, zero-copy."""
+    off = 0
+    (meta_len,) = _HEADER.unpack_from(view, off)
+    off += _HEADER.size
+    meta = bytes(view[off : off + meta_len])
+    off += meta_len
+    (nbuf,) = _HEADER.unpack_from(view, off)
+    off += _HEADER.size
+    buffers = []
+    for _ in range(nbuf):
+        (packed,) = _HEADER.unpack_from(view, off)
+        pad = packed >> 48
+        nbytes = packed & ((1 << 48) - 1)
+        off += _HEADER.size + pad
+        buffers.append(view[off : off + nbytes].toreadonly())
+        off += nbytes
+    return pickle.loads(meta, buffers=buffers)
+
+
+def loads(data: bytes) -> Any:
+    return loads_from(memoryview(data))

@@ -1,0 +1,32 @@
+"""Builds the native shared libraries on first import (cached by mtime)."""
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_LIBS = {
+    "libshmstore.so": ["shm_store.cpp"],
+}
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(_DIR, name)
+
+
+def ensure_built(name: str = "libshmstore.so") -> str:
+    sources = [os.path.join(_DIR, s) for s in _LIBS[name]]
+    out = lib_path(name)
+    if os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in sources
+    ):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        *sources, "-o", tmp,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out

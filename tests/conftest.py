@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Multi-chip TPU hardware is not available in CI; per the build contract all
+mesh/sharding tests run against XLA's host-platform virtual devices
+(mirrors how the reference fakes multi-node clusters on one machine,
+reference: python/ray/cluster_utils.py:135).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

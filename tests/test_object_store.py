@@ -1,0 +1,134 @@
+"""Unit tests for the native shm object store + serialization layer.
+
+Modeled on the reference's plasma/client tests
+(reference: src/ray/object_manager/plasma/test, python test_plasma*).
+"""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_store import (
+    ObjectExistsError,
+    ObjectStoreFullError,
+    ShmClient,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = str(tmp_path / "arena")
+    c = ShmClient(path, capacity=64 * 1024 * 1024, create=True)
+    yield c
+    c.close()
+
+
+def _oid():
+    return ObjectID.for_task_return(TaskID.for_job(JobID.from_int(1)), 0)
+
+
+def test_serialization_roundtrip():
+    obj = {"a": np.arange(10000, dtype=np.float32), "b": [1, "two", None]}
+    data = serialization.dumps(obj)
+    out = serialization.loads(data)
+    assert out["b"] == obj["b"]
+    np.testing.assert_array_equal(out["a"], obj["a"])
+
+
+def test_put_get_roundtrip(store):
+    oid = _oid()
+    arr = np.random.rand(1000, 100)
+    store.put(oid, {"x": arr, "tag": "hello"})
+    out = store.get(oid, timeout_ms=1000)
+    assert out["tag"] == "hello"
+    np.testing.assert_array_equal(out["x"], arr)
+
+
+def test_zero_copy_read(store):
+    oid = _oid()
+    arr = np.arange(1 << 20, dtype=np.uint8)
+    store.put(oid, arr)
+    out = store.get(oid)
+    # zero-copy: the result should be read-only (backed by the arena mapping)
+    assert not out.flags.writeable
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_create_exists(store):
+    oid = _oid()
+    store.put(oid, 1)
+    with pytest.raises(ObjectExistsError):
+        store.create(oid, 10)
+
+
+def test_contains_delete(store):
+    oid = _oid()
+    assert not store.contains(oid)
+    store.put(oid, [1, 2, 3])
+    assert store.contains(oid)
+    store.delete(oid)
+
+
+def test_get_timeout(store):
+    assert store.get_buffer(_oid(), timeout_ms=50) is None
+
+
+def test_lru_eviction(store):
+    # fill past capacity with unpinned objects; store must evict, not fail
+    big = np.zeros(4 << 20, dtype=np.uint8)
+    oids = []
+    for i in range(30):  # 30 * 4MB > 64MB arena
+        oid = _oid()
+        store.put(oid, big)
+        store.release(oid)  # drop any read refs (put holds none)
+        oids.append(oid)
+    stats = store.stats()
+    assert stats["num_evictions"] > 0
+    assert store.contains(oids[-1])
+
+
+def test_store_full_when_pinned(store):
+    oids = []
+    for i in range(200):
+        oid = _oid()
+        try:
+            store.put(oid, np.zeros(4 << 20, dtype=np.uint8))
+        except ObjectStoreFullError:
+            break
+        # pin by reading
+        store.get_buffer(oid, timeout_ms=100)
+        oids.append(oid)
+    else:
+        pytest.fail("expected ObjectStoreFullError with all objects pinned")
+    for oid in oids:
+        store.release(oid)
+
+
+def _child_reader(path, oid_bytes, q):
+    c = ShmClient(path)
+    out = c.get(ObjectID(oid_bytes), timeout_ms=5000)
+    q.put(int(out.sum()))
+    c.close()
+
+
+def test_cross_process_get(store, tmp_path):
+    oid = _oid()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_reader, args=(store.path, oid.binary(), q))
+    p.start()
+    arr = np.ones(100000, dtype=np.int64)
+    store.put(oid, arr)  # seal wakes the waiting child
+    assert q.get(timeout=20) == 100000
+    p.join(timeout=10)
+
+
+def test_stats(store):
+    s0 = store.stats()
+    store.put(_oid(), np.zeros(1 << 20))
+    s1 = store.stats()
+    assert s1["num_objects"] == s0["num_objects"] + 1
+    assert s1["used_bytes"] > s0["used_bytes"]
