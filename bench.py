@@ -1,0 +1,82 @@
+"""Benchmark: flagship-model training throughput (tokens/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no model-level numbers in-repo (BASELINE.md); the
+north-star metric (BASELINE.json) is Llama tokens/sec/chip on TPU. The
+baseline constant below is the roofline-derived target for one v5e chip on
+the ~1.1B flagship config (bf16 MFU ~40%): ~197 bf16 TFLOP/s peak * 0.4 /
+(6 * 1.1e9 FLOP/token) ≈ 1.2e4 tokens/s. vs_baseline = value / baseline.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 12000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_single_chip
+    from ray_tpu.models import make_train_step
+    from ray_tpu.parallel import MeshSpec, create_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = _flagship_single_chip()
+    if not on_tpu:
+        # CPU smoke sizing so the bench always produces a line
+        from ray_tpu.models import LlamaConfig
+
+        cfg = LlamaConfig.tiny(n_layers=2, dim=64, vocab_size=512)
+
+    n_chips = len(jax.devices())
+    mesh = create_mesh(MeshSpec(fsdp=-1), jax.devices())
+
+    B, S = (8, 1024) if on_tpu else (4, 64)
+    init, step = make_train_step(cfg, mesh)
+    state = init(0)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S + 1)),
+        dtype=jnp.int32,
+    )
+
+    # warmup (compile); float() forces a device->host transfer, which some
+    # PJRT transports require for a true sync (block_until_ready alone can
+    # be a no-op on tunneled backends)
+    for _ in range(2):
+        state, metrics = step(state, tokens)
+    _ = float(metrics["loss"])
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, tokens)
+    final_loss = float(metrics["loss"])  # forces the whole step chain
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 2),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(
+                    tokens_per_sec / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4
+                ),
+                "model_params": cfg.num_params(),
+                "backend": jax.default_backend(),
+                "chips": n_chips,
+                "final_loss": final_loss,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

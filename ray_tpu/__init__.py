@@ -1,0 +1,42 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+A ground-up re-design of the reference system (Ray) for TPU clusters:
+tasks, actors, objects, and placement groups over a gRPC-style control
+plane and shared-memory object store; jax/XLA/pjit as the in-slice data
+plane; Pallas kernels for long-context attention; Train/Data/Serve/Tune
+libraries built purely on the public core API.
+"""
+from ._private.core_worker import (  # noqa: F401
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectRef,
+    RayActorError,
+    RayError,
+    RayTaskError,
+    TaskCancelledError,
+)
+from .actor import ActorClass, ActorHandle, method  # noqa: F401
+from .api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from .remote_function import RemoteFunction  # noqa: F401
+from .util.placement_group import (  # noqa: F401
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+__version__ = "0.1.0"

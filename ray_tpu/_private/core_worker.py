@@ -1,0 +1,1617 @@
+"""CoreWorker — the per-process runtime embedded in drivers and workers.
+
+Reference: src/ray/core_worker/core_worker.h:166 — one object per process
+handling task submission (transport/normal_task_submitter.cc with leased
+workers + spillback), actor submission (transport/actor_task_submitter.h:75
+with ordered per-actor queues and restart handling), owner-based object
+management (reference_count.h:73), retries + lineage reconstruction
+(task_manager.h:168, object_recovery_manager.h:43), and the in-process
+memory store for small objects (memory_store.h:45).
+
+Ownership model (same as the reference): the process that creates an
+ObjectRef (by task submission or put) is its *owner*; the owner stores the
+authoritative record — inline value, or shm locations + lineage — and serves
+location/value queries to borrowers. Small values travel inline inside RPC
+replies; large values are written to the node-local shared-memory arena and
+pulled between nodes by raylets in chunks.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from . import serialization
+from .config import get_config
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .object_store import ObjectStoreFullError, ShmClient
+from .rpc import (
+    ClientPool,
+    EventLoopThread,
+    RpcApplicationError,
+    RpcClient,
+    RpcConnectionError,
+    RpcServer,
+)
+
+
+class RayError(Exception):
+    pass
+
+
+class RayTaskError(RayError):
+    """A task/actor method raised; carries the remote traceback."""
+
+    def __init__(self, message: str, cause_cls: str = "Exception"):
+        super().__init__(message)
+        self.cause_cls = cause_cls
+
+
+class RayActorError(RayError):
+    pass
+
+
+class ObjectLostError(RayError):
+    pass
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# ObjectRef
+# ---------------------------------------------------------------------------
+_global_worker = None  # set by connect()
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first"
+        )
+    return _global_worker
+
+
+def _rehydrate_ref(oid_bytes: bytes, owner_addr):
+    ref = ObjectRef(ObjectID(oid_bytes), tuple(owner_addr) if owner_addr else None,
+                    _register=False)
+    w = _global_worker
+    if w is not None:
+        w.register_borrowed_ref(ref)
+    return ref
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address=None, _register=True):
+        self.id = object_id
+        self.owner_address = owner_address
+        if _register and _global_worker is not None:
+            _global_worker.add_local_ref(self.id)
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self) -> TaskID:
+        return self.id.task_id()
+
+    def __reduce__(self):
+        return (_rehydrate_ref, (self.id.binary(), self.owner_address))
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        w = _global_worker
+        if w is not None:
+            try:
+                w.remove_local_ref(self.id)
+            except Exception:
+                pass
+
+    # `await ref` support inside async actors
+    def __await__(self):
+        return self.as_future().__await__()
+
+    def as_future(self):
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+
+        def _resolve():
+            try:
+                val = global_worker().get_objects([self], timeout=None)[0]
+                loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(val)
+                )
+            except Exception as e:
+                loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_exception(e)
+                )
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+
+# ---------------------------------------------------------------------------
+# In-process memory store (reference: memory_store.h:45)
+# ---------------------------------------------------------------------------
+class MemoryStore:
+    def __init__(self):
+        self._objects: Dict[bytes, Any] = {}
+        self._cv = threading.Condition()
+
+    def put(self, oid: ObjectID, value: Any):
+        with self._cv:
+            self._objects[oid.binary()] = value
+            self._cv.notify_all()
+
+    def contains(self, oid: ObjectID) -> bool:
+        return oid.binary() in self._objects
+
+    def get(self, oid: ObjectID):
+        return self._objects[oid.binary()]
+
+    def wait_for(self, oid: ObjectID, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while oid.binary() not in self._objects:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cv.wait(remaining if remaining is not None else 1.0)
+            return True
+
+    def delete(self, oid: ObjectID):
+        with self._cv:
+            self._objects.pop(oid.binary(), None)
+
+
+class _Sentinel:
+    """Marks 'value lives in shm' inside owner records."""
+
+    __slots__ = ()
+
+
+_IN_SHM = _Sentinel()
+
+
+# ---------------------------------------------------------------------------
+# Owner-side object record (reference: reference_count.h:73)
+# ---------------------------------------------------------------------------
+class _ObjectRecord:
+    __slots__ = (
+        "local_refs", "borrowers", "locations", "size", "pending",
+        "error", "lineage_task_id", "event",
+    )
+
+    def __init__(self):
+        self.local_refs = 0
+        self.borrowers = 0
+        self.locations: set = set()  # node_id hex with a sealed shm copy
+        self.size: Optional[int] = None
+        self.pending = True
+        self.error: Optional[bytes] = None  # serialized exception
+        self.lineage_task_id: Optional[bytes] = None
+        self.event = threading.Event()
+
+
+# ---------------------------------------------------------------------------
+# Task bookkeeping (reference: task_manager.h:168)
+# ---------------------------------------------------------------------------
+class _TaskRecord:
+    __slots__ = ("spec", "retries_left", "status", "return_ids", "is_actor",
+                 "retained")
+
+    def __init__(self, spec: dict, retries_left: int, return_ids,
+                 retained=()):
+        self.spec = spec
+        self.retries_left = retries_left
+        self.status = "PENDING"
+        self.return_ids = return_ids
+        self.is_actor = False
+        # ObjectIDs pinned while this task is in flight (arg references)
+        self.retained = list(retained)
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        *,
+        mode: str,  # "driver" | "worker"
+        node_id: str,
+        raylet_address: Tuple[str, int],
+        gcs_address: Tuple[str, int],
+        arena_path: str,
+        job_id: Optional[JobID] = None,
+        worker_id: Optional[str] = None,
+        session_dir: str = "/tmp/ray_tpu",
+    ):
+        from .gcs import GcsClient  # local import to avoid cycle
+
+        self.mode = mode
+        self.node_id = node_id
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self.job_id = job_id or JobID.from_int(os.getpid() % (1 << 31))
+        self.session_dir = session_dir
+        self._cfg = get_config()
+
+        self.raylet = RpcClient(*raylet_address)
+        self.raylet_address = raylet_address
+        self.gcs = GcsClient(*gcs_address)
+        self.gcs_address = gcs_address
+        self.store = ShmClient(arena_path)
+        self._pool = ClientPool()
+
+        self.memory_store = MemoryStore()
+        self._records: Dict[bytes, _ObjectRecord] = {}
+        self._borrowed: Dict[bytes, list] = {}  # oid -> [count, owner_addr]
+        self._records_lock = threading.RLock()
+        self._tasks: Dict[bytes, _TaskRecord] = {}
+        self._put_index = 0
+        self._put_task_id = TaskID.for_job(self.job_id)
+        self._task_counter = 0
+
+        # RPC server: owner services + (worker mode) task execution
+        self._server = RpcServer("127.0.0.1", 0)
+        self._register_handlers()
+
+        # normal-task submitter state
+        self._sched_classes: Dict[tuple, "_LeasePool"] = {}
+        self._sched_lock = threading.Lock()
+
+        # actor submitters (by actor_id hex)
+        self._actor_subs: Dict[str, "_ActorSubmitter"] = {}
+
+        # execution side
+        self.actor_instance = None
+        self.actor_id: Optional[str] = None
+        # per-caller expected sequence numbers (ordered actor queues;
+        # reference: actor_scheduling_queue.cc)
+        self._actor_next_seq: Dict[str, int] = collections.defaultdict(int)
+        self._actor_seq_cond: Optional[asyncio.Condition] = None
+        self._max_concurrency = 1
+        self._actor_executor: Optional[ThreadPoolExecutor] = None
+        self._task_executor = ThreadPoolExecutor(
+            max_workers=max(4, (os.cpu_count() or 4))
+        )
+        self._exit = threading.Event()
+
+        self.address: Optional[Tuple[str, int]] = None
+        self._task_events: List[dict] = []
+        self._task_events_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def start(self):
+        loop = EventLoopThread.get()
+        loop.run(self._server.start())
+        self.address = self._server.address
+        global _global_worker
+        _global_worker = self
+        loop.spawn(self._flush_task_events_loop())
+        loop.spawn(self._actor_event_loop())
+
+    def shutdown(self):
+        self._exit.set()
+        try:
+            EventLoopThread.get().run(self._server.stop(), 5.0)
+        except Exception:
+            pass
+        self._pool.close_all()
+        self.raylet.close_sync()
+        self.gcs.close()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+        global _global_worker
+        if _global_worker is self:
+            _global_worker = None
+
+    def _register_handlers(self):
+        s = self._server
+        s.register_method("get_object_info", self._rpc_get_object_info)
+        s.register_method("add_borrower", self._rpc_add_borrower)
+        s.register_method("remove_borrower", self._rpc_remove_borrower)
+        s.register_method("push_task", self._rpc_push_task)
+        s.register_method("push_actor_creation", self._rpc_push_actor_creation)
+        s.register_method("push_actor_task", self._rpc_push_actor_task)
+        s.register_method("exit_worker", self._rpc_exit_worker)
+        s.register_method("cancel_task", self._rpc_cancel_task)
+        s.register_method("ping", self._rpc_ping)
+
+    async def _rpc_ping(self):
+        return "pong"
+
+    # ==================================================================
+    # put / get / wait
+    # ==================================================================
+    def _next_put_id(self) -> ObjectID:
+        self._put_index += 1
+        return ObjectID.for_task_return(self._put_task_id, self._put_index)
+
+    def put_object(self, value: Any, _owner_inline_hint: bool = True) -> ObjectRef:
+        oid = self._next_put_id()
+        meta, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(meta, buffers)
+        rec = _ObjectRecord()
+        rec.pending = False
+        rec.size = size
+        if size <= self._cfg.max_inline_object_size:
+            # Store a deserialized COPY, not the live object: put() must
+            # snapshot (callers may mutate `value` afterwards; reference
+            # semantics are copy-on-put).
+            buf = bytearray(size)
+            serialization.write_into(memoryview(buf), meta, buffers)
+            self.memory_store.put(oid, serialization.loads(bytes(buf)))
+        else:
+            self._write_shm(oid, meta, buffers, size)
+            rec.locations.add(self.node_id)
+        with self._records_lock:
+            self._records[oid.binary()] = rec
+        rec.event.set()
+        return ObjectRef(oid, self.address)
+
+    def _write_shm(self, oid: ObjectID, meta, buffers, size: int):
+        try:
+            view = self.store.create(oid, size)
+        except ObjectStoreFullError:
+            self.raylet.call_sync("ensure_space", nbytes=size)
+            view = self.store.create(oid, size)
+        try:
+            serialization.write_into(view, meta, buffers)
+        finally:
+            view.release()
+        self.store.seal(oid)
+
+    def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(r, deadline) for r in refs]
+
+    def _remaining(self, deadline) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise GetTimeoutError("ray_tpu.get timed out")
+        return rem
+
+    def _get_one(self, ref: ObjectRef, deadline):
+        oid = ref.id
+        # 1. in-process memory store
+        if self.memory_store.contains(oid):
+            return self._maybe_raise(self.memory_store.get(oid))
+        with self._records_lock:
+            rec = self._records.get(oid.binary())
+        if rec is not None:
+            return self._get_owned(ref, rec, deadline)
+        return self._get_borrowed(ref, deadline)
+
+    def _maybe_raise(self, value):
+        if isinstance(value, RayError):
+            raise value
+        return value
+
+    def _get_owned(self, ref: ObjectRef, rec: _ObjectRecord, deadline):
+        oid = ref.id
+        while True:
+            rem = self._remaining(deadline)
+            if not rec.event.wait(timeout=rem if rem is not None else 1.0):
+                if rem is not None:
+                    raise GetTimeoutError("ray_tpu.get timed out")
+                continue
+            break
+        if rec.error is not None:
+            raise serialization.loads(rec.error)
+        if self.memory_store.contains(oid):
+            return self._maybe_raise(self.memory_store.get(oid))
+        # large object in shm somewhere
+        value = self._read_shm_anywhere(oid, rec.locations, deadline)
+        if value is not _IN_SHM:
+            return value
+        # All locations lost: lineage reconstruction.
+        if (
+            self._cfg.enable_lineage_reconstruction
+            and rec.lineage_task_id is not None
+        ):
+            if self._resubmit_task(rec.lineage_task_id):
+                rec.event.clear()
+                rec.pending = True
+                return self._get_owned(ref, rec, deadline)
+        raise ObjectLostError(f"object {oid.hex()} lost and not recoverable")
+
+    def _read_shm_anywhere(self, oid: ObjectID, locations, deadline):
+        """Read from local arena, else pull via raylet. Returns _IN_SHM
+        sentinel if unrecoverable here."""
+        buf = self.store.get_buffer(oid)
+        if buf is not None:
+            try:
+                return serialization.loads_from(buf)
+            finally:
+                pass  # keep read ref; raylet reconciles on process exit
+        alive = self._alive_nodes()
+        for node_id in list(locations):
+            info = alive.get(node_id)
+            if info is None:
+                continue
+            addr = info["address"]
+            ok = self.raylet.call_sync(
+                "pull_object", object_id=oid.binary(), from_address=list(addr),
+                timeout=self._remaining(deadline),
+            )
+            if ok:
+                buf = self.store.get_buffer(oid)
+                if buf is not None:
+                    return serialization.loads_from(buf)
+        return _IN_SHM
+
+    def _alive_nodes(self) -> Dict[str, dict]:
+        view = self.gcs.get_cluster_view()
+        return {nid: v for nid, v in view.items() if v["alive"]}
+
+    def _get_borrowed(self, ref: ObjectRef, deadline):
+        """Object owned by another process: ask the owner."""
+        if ref.owner_address is None:
+            raise ObjectLostError(f"no owner known for {ref.id.hex()}")
+        owner = self._pool.get(*ref.owner_address)
+        while True:
+            rem = self._remaining(deadline)
+            try:
+                info = owner.call_sync(
+                    "get_object_info",
+                    object_id=ref.id.binary(),
+                    wait=True,
+                    timeout=min(rem, 10.0) if rem is not None else 10.0,
+                )
+            except (RpcConnectionError, TimeoutError):
+                # Owner death ⇒ objects it owned are lost (same as reference).
+                buf = self.store.get_buffer(ref.id)
+                if buf is not None:
+                    return serialization.loads_from(buf)
+                raise ObjectLostError(
+                    f"owner of {ref.id.hex()} at {ref.owner_address} is "
+                    f"unreachable"
+                ) from None
+            if info.get("pending"):
+                continue
+            if "error" in info:
+                raise serialization.loads(info["error"])
+            if "inline" in info:
+                value = serialization.loads(info["inline"])
+                self.memory_store.put(ref.id, value)
+                return self._maybe_raise(value)
+            value = self._read_shm_anywhere(
+                ref.id, info.get("locations", ()), deadline
+            )
+            if value is not _IN_SHM:
+                return value
+            raise ObjectLostError(f"object {ref.id.hex()} unreachable")
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+        fetch_local: bool = True,
+    ):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            still = []
+            for r in pending:
+                if self._is_ready(r):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        if self.memory_store.contains(ref.id):
+            return True
+        with self._records_lock:
+            rec = self._records.get(ref.id.binary())
+        if rec is not None:
+            return rec.event.is_set()
+        if self.store.contains(ref.id):
+            return True
+        if ref.owner_address is None:
+            return False
+        try:
+            info = self._pool.get(*ref.owner_address).call_sync(
+                "get_object_info", object_id=ref.id.binary(), wait=False,
+                timeout=5.0,
+            )
+            return not info.get("pending", False)
+        except Exception:
+            return False
+
+    # ==================================================================
+    # reference counting (owner + borrower sides)
+    # ==================================================================
+    def add_local_ref(self, oid: ObjectID):
+        with self._records_lock:
+            rec = self._records.get(oid.binary())
+            if rec is not None:
+                rec.local_refs += 1
+
+    def remove_local_ref(self, oid: ObjectID):
+        with self._records_lock:
+            rec = self._records.get(oid.binary())
+            if rec is not None:
+                rec.local_refs -= 1
+                if (
+                    rec.local_refs <= 0
+                    and rec.borrowers <= 0
+                    and not rec.pending
+                ):
+                    self._free_object(oid, rec)
+                return
+            ent = self._borrowed.get(oid.binary())
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0:
+                    self._borrowed.pop(oid.binary(), None)
+                    self.memory_store.delete(oid)
+                    owner = self._pool.get(*ent[1])
+                    EventLoopThread.get().spawn(
+                        owner.call("remove_borrower",
+                                   object_id=oid.binary())
+                    )
+
+    def _retain_ref(self, oid: ObjectID, owner_address):
+        """Pin an object while it's an in-flight task argument (the
+        reference counts submitted-task args in reference_count.h)."""
+        with self._records_lock:
+            rec = self._records.get(oid.binary())
+            if rec is not None:
+                rec.local_refs += 1
+                return
+            ent = self._borrowed.get(oid.binary())
+            if ent is not None:
+                ent[0] += 1
+                return
+            if owner_address and tuple(owner_address) != self.address:
+                self._borrowed[oid.binary()] = [1, tuple(owner_address)]
+                owner = self._pool.get(*owner_address)
+                EventLoopThread.get().spawn(
+                    owner.call("add_borrower", object_id=oid.binary())
+                )
+
+    def _release_ref(self, oid: ObjectID):
+        self.remove_local_ref(oid)
+
+    def register_borrowed_ref(self, ref: ObjectRef):
+        # Best-effort async notification to the owner (the reference tracks
+        # borrowers precisely via the borrowing protocol; we approximate).
+        if ref.owner_address is None or ref.owner_address == self.address:
+            self.add_local_ref(ref.id)
+            return
+        with self._records_lock:
+            ent = self._borrowed.get(ref.id.binary())
+            if ent is not None:
+                ent[0] += 1
+                return
+            self._borrowed[ref.id.binary()] = [1, tuple(ref.owner_address)]
+        owner = self._pool.get(*ref.owner_address)
+        EventLoopThread.get().spawn(
+            owner.call("add_borrower", object_id=ref.id.binary())
+        )
+
+    async def _rpc_add_borrower(self, object_id: bytes):
+        with self._records_lock:
+            rec = self._records.get(object_id)
+            if rec is not None:
+                rec.borrowers += 1
+        return True
+
+    async def _rpc_remove_borrower(self, object_id: bytes):
+        with self._records_lock:
+            rec = self._records.get(object_id)
+            if rec is not None:
+                rec.borrowers -= 1
+                if (
+                    rec.local_refs <= 0
+                    and rec.borrowers <= 0
+                    and not rec.pending
+                ):
+                    self._free_object(ObjectID(object_id), rec)
+        return True
+
+    def _free_object(self, oid: ObjectID, rec: _ObjectRecord):
+        self._records.pop(oid.binary(), None)
+        self.memory_store.delete(oid)
+        if rec.locations:
+            # Fire-and-forget shm deletion on every node holding a copy.
+            # Must not block: this can run on the io thread (borrower RPC).
+            EventLoopThread.get().spawn(
+                self._free_shm_copies(oid.binary(), set(rec.locations))
+            )
+
+    async def _free_shm_copies(self, oid_bytes: bytes, locations: set):
+        try:
+            view = await self.gcs.aio.call("get_cluster_view")
+        except Exception:
+            return
+        for node_id in locations:
+            info = view.get(node_id)
+            if info is None or not info.get("alive"):
+                continue
+            try:
+                cli = self._pool.get(*info["address"])
+                await cli.call("delete_objects", object_ids=[oid_bytes])
+            except Exception:
+                pass
+
+    async def _rpc_get_object_info(self, object_id: bytes, wait: bool = False):
+        """Owner service: value (inline), locations (shm), pending or error."""
+        oid = ObjectID(object_id)
+        deadline = time.monotonic() + 9.0
+        while True:
+            with self._records_lock:
+                rec = self._records.get(object_id)
+            if rec is None:
+                if self.memory_store.contains(oid):
+                    return {
+                        "inline": serialization.dumps(self.memory_store.get(oid))
+                    }
+                return {"error": serialization.dumps(
+                    ObjectLostError(f"{oid.hex()} unknown to owner")
+                )}
+            if rec.event.is_set():
+                if rec.error is not None:
+                    return {"error": rec.error}
+                if self.memory_store.contains(oid):
+                    return {
+                        "inline": serialization.dumps(self.memory_store.get(oid))
+                    }
+                return {"locations": list(rec.locations), "size": rec.size}
+            if not wait or time.monotonic() > deadline:
+                return {"pending": True}
+            await asyncio.sleep(0.005)
+
+    # ==================================================================
+    # normal task submission (reference: normal_task_submitter.cc)
+    # ==================================================================
+    def submit_task(
+        self,
+        func,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        demand: Optional[Dict[str, float]] = None,
+        max_retries: Optional[int] = None,
+        strategy: str = "DEFAULT",
+        strategy_params: Optional[dict] = None,
+        name: str = "",
+        serialized_func: Optional[bytes] = None,
+    ) -> List[ObjectRef]:
+        self._task_counter += 1
+        task_id = TaskID.for_job(self.job_id)
+        demand = dict(demand or {"CPU": 1.0})
+        if max_retries is None:
+            max_retries = self._cfg.default_task_max_retries
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.hex(),
+            "name": name or getattr(func, "__name__", "task"),
+            "func": serialized_func
+            if serialized_func is not None
+            else cloudpickle.dumps(func),
+            "args": self._pack_args(args),
+            "kwargs": {k: self._pack_arg(v) for k, v in kwargs.items()},
+            "num_returns": num_returns,
+            "demand": demand,
+            "strategy": strategy,
+            "strategy_params": strategy_params or {},
+            "owner_address": list(self.address),
+        }
+        return_ids = [
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        ]
+        arg_refs = [a for a in args if isinstance(a, ObjectRef)] + [
+            v for v in kwargs.values() if isinstance(v, ObjectRef)
+        ]
+        for r in arg_refs:
+            self._retain_ref(r.id, r.owner_address)
+        with self._records_lock:
+            for oid in return_ids:
+                rec = _ObjectRecord()
+                rec.lineage_task_id = task_id.binary()
+                # pre-bias for the ObjectRef we hand back below, so a task
+                # completing before the ref exists can't free the record
+                rec.local_refs = 1
+                self._records[oid.binary()] = rec
+            self._tasks[task_id.binary()] = _TaskRecord(
+                spec, max_retries, [o.binary() for o in return_ids],
+                retained=[r.id for r in arg_refs],
+            )
+        self._record_task_event(spec, "PENDING")
+        pool = self._lease_pool(demand, strategy, strategy_params)
+        pool.enqueue(spec)
+        return [
+            ObjectRef(oid, self.address, _register=False)
+            for oid in return_ids
+        ]
+
+    def _pack_args(self, args):
+        return [self._pack_arg(a) for a in args]
+
+    def _pack_arg(self, a):
+        if isinstance(a, ObjectRef):
+            return ("ref", a.id.binary(), a.owner_address)
+        return ("v", serialization.dumps(a))
+
+    def _lease_pool(self, demand, strategy, strategy_params) -> "_LeasePool":
+        params = strategy_params or {}
+        key = (
+            tuple(sorted(demand.items())),
+            strategy,
+            params.get("placement_group_id"),
+            params.get("bundle_index", -1),
+            params.get("node_id"),
+        )
+        with self._sched_lock:
+            pool = self._sched_classes.get(key)
+            if pool is None:
+                pool = _LeasePool(self, demand, strategy, params)
+                self._sched_classes[key] = pool
+            return pool
+
+    def _on_task_done(self, spec: dict, returns: List[tuple], node_id: str):
+        """Submitter callback with the executor's reply."""
+        task_id = spec["task_id"]
+        with self._records_lock:
+            task = self._tasks.get(task_id)
+            if task is not None:
+                task.status = "FINISHED"
+        if task is not None:
+            retained, task.retained = task.retained, []
+            for oid in retained:
+                self._release_ref(oid)
+        for oid_bytes, kind, payload in returns:
+            oid = ObjectID(oid_bytes)
+            with self._records_lock:
+                rec = self._records.get(oid_bytes)
+                if rec is None:
+                    rec = _ObjectRecord()
+                    self._records[oid_bytes] = rec
+                rec.pending = False
+                if kind == "inline":
+                    self.memory_store.put(oid, serialization.loads(payload))
+                elif kind == "shm":
+                    rec.size = payload["size"]
+                    rec.locations.add(node_id)
+                elif kind == "err":
+                    rec.error = payload
+                rec.event.set()
+                # caller may have dropped every ref while we were pending —
+                # re-check so fire-and-forget tasks don't leak records
+                if rec.local_refs <= 0 and rec.borrowers <= 0:
+                    self._free_object(oid, rec)
+        self._record_task_event(spec, "FINISHED")
+
+    def _on_task_failed(self, spec: dict, error: Exception) -> bool:
+        """Returns True if the task will be retried."""
+        task_id = spec["task_id"]
+        with self._records_lock:
+            task = self._tasks.get(task_id)
+            if task is not None and task.retries_left > 0:
+                task.retries_left -= 1
+                self._record_task_event(spec, "RETRYING")
+                return True
+            err = serialization.dumps(
+                RayTaskError(
+                    f"task {spec.get('name')} failed: {error}",
+                    type(error).__name__,
+                )
+            )
+            for oid_bytes in (task.return_ids if task else ()):
+                rec = self._records.get(oid_bytes)
+                if rec is not None:
+                    rec.pending = False
+                    rec.error = err
+                    rec.event.set()
+                    if rec.local_refs <= 0 and rec.borrowers <= 0:
+                        self._free_object(ObjectID(oid_bytes), rec)
+            if task is not None:
+                task.status = "FAILED"
+        if task is not None:
+            retained, task.retained = task.retained, []
+            for oid in retained:
+                self._release_ref(oid)
+        self._record_task_event(spec, "FAILED")
+        return False
+
+    def _resubmit_task(self, task_id: bytes) -> bool:
+        """Lineage reconstruction (reference: object_recovery_manager.h:43)."""
+        with self._records_lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                return False
+            spec = task.spec
+            task.status = "RESUBMITTED"
+        if task.is_actor:
+            return False  # actor results are not reconstructable
+        pool = self._lease_pool(
+            spec["demand"], spec["strategy"], spec["strategy_params"]
+        )
+        pool.enqueue(spec)
+        return True
+
+    # ==================================================================
+    # actors — submission side
+    # ==================================================================
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        *,
+        demand: Optional[Dict[str, float]] = None,
+        name: Optional[str] = None,
+        namespace: str = "",
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        detached: bool = False,
+        strategy: str = "DEFAULT",
+        strategy_params: Optional[dict] = None,
+        runtime_env: Optional[dict] = None,
+        serialized_cls: Optional[bytes] = None,
+        methods: Optional[dict] = None,
+    ) -> str:
+        actor_id = ActorID.of(self.job_id).hex()
+        creation = cloudpickle.dumps(
+            {
+                "cls": serialized_cls
+                if serialized_cls is not None
+                else cloudpickle.dumps(cls),
+                "args": self._pack_args(args),
+                "kwargs": {k: self._pack_arg(v) for k, v in kwargs.items()},
+                "max_concurrency": max_concurrency,
+                "actor_id": actor_id,
+                "owner_address": list(self.address),
+            }
+        )
+        params = strategy_params or {}
+        spec = {
+            "actor_id": actor_id,
+            "job_id": self.job_id.hex(),
+            "name": name,
+            "namespace": namespace,
+            "demand": dict(demand or {"CPU": 1.0}),
+            "max_restarts": max_restarts,
+            "max_task_retries": max_task_retries,
+            "detached": detached,
+            "strategy": strategy,
+            "affinity_node_id": params.get("node_id"),
+            "affinity_soft": params.get("soft", False),
+            "label_selector": params.get("label_selector", {}),
+            "placement_group_id": params.get("placement_group_id"),
+            "placement_group_bundle_index": params.get("bundle_index", -1),
+            "runtime_env": runtime_env,
+            "creation_task": creation,
+            "owner_address": list(self.address),
+            "methods": methods or {},
+        }
+        res = self.gcs.register_actor(spec=spec)
+        if not res.get("ok"):
+            raise ValueError(res.get("error", "actor registration failed"))
+        self._actor_subs[actor_id] = _ActorSubmitter(
+            self, actor_id, max_task_retries
+        )
+        return actor_id
+
+    def actor_submitter(self, actor_id: str,
+                        max_task_retries: int = 0) -> "_ActorSubmitter":
+        sub = self._actor_subs.get(actor_id)
+        if sub is None:
+            sub = _ActorSubmitter(self, actor_id, max_task_retries)
+            self._actor_subs[actor_id] = sub
+        return sub
+
+    def submit_actor_task(
+        self,
+        actor_id: str,
+        method_name: str,
+        args,
+        kwargs,
+        *,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.for_job(self.job_id)
+        return_ids = [
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        ]
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.hex(),
+            "name": method_name,
+            "method": method_name,
+            "args": self._pack_args(args),
+            "kwargs": {k: self._pack_arg(v) for k, v in kwargs.items()},
+            "num_returns": num_returns,
+            "owner_address": list(self.address),
+        }
+        arg_refs = [a for a in args if isinstance(a, ObjectRef)] + [
+            v for v in kwargs.values() if isinstance(v, ObjectRef)
+        ]
+        for r in arg_refs:
+            self._retain_ref(r.id, r.owner_address)
+        with self._records_lock:
+            for oid in return_ids:
+                r = _ObjectRecord()
+                r.local_refs = 1  # pre-biased for the handed-back ref
+                self._records[oid.binary()] = r
+            rec = _TaskRecord(spec, max_task_retries,
+                              [o.binary() for o in return_ids],
+                              retained=[r.id for r in arg_refs])
+            rec.is_actor = True
+            self._tasks[task_id.binary()] = rec
+        self.actor_submitter(actor_id, max_task_retries).enqueue(spec)
+        return [
+            ObjectRef(oid, self.address, _register=False)
+            for oid in return_ids
+        ]
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        self.gcs.kill_actor(actor_id=actor_id, no_restart=no_restart)
+
+    # ==================================================================
+    # execution side (worker mode)
+    # ==================================================================
+    async def _rpc_push_task(self, spec: dict):
+        """Execute a normal task; reply with packed returns."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._task_executor, self._execute_task, spec
+        )
+
+    def _execute_task(self, spec: dict):
+        try:
+            func = cloudpickle.loads(spec["func"])
+            args = [self._unpack_arg(a) for a in spec["args"]]
+            kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
+            result = func(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — shipped to the owner
+            tb = traceback.format_exc()
+            err = serialization.dumps(
+                RayTaskError(f"{type(e).__name__}: {e}\n{tb}", type(e).__name__)
+            )
+            task_id = TaskID(spec["task_id"])
+            return {
+                "returns": [
+                    (
+                        ObjectID.for_task_return(task_id, i).binary(),
+                        "err",
+                        err,
+                    )
+                    for i in range(spec["num_returns"])
+                ],
+                "node_id": self.node_id,
+            }
+        return {
+            "returns": self._pack_returns(spec, result),
+            "node_id": self.node_id,
+        }
+
+    def _pack_returns(self, spec: dict, result):
+        num_returns = spec["num_returns"]
+        task_id = TaskID(spec["task_id"])
+        if num_returns == 1:
+            values = [result]
+        elif num_returns == 0:
+            values = []
+        else:
+            values = list(result)
+            if len(values) != num_returns:
+                raise ValueError(
+                    f"task declared {num_returns} returns but produced "
+                    f"{len(values)}"
+                )
+        out = []
+        for i, value in enumerate(values):
+            oid = ObjectID.for_task_return(task_id, i)
+            meta, buffers = serialization.serialize(value)
+            size = serialization.serialized_size(meta, buffers)
+            if size <= self._cfg.max_inline_object_size:
+                buf = bytearray(size)
+                serialization.write_into(memoryview(buf), meta, buffers)
+                out.append((oid.binary(), "inline", bytes(buf)))
+            else:
+                self._write_shm(oid, meta, buffers, size)
+                out.append((oid.binary(), "shm", {"size": size}))
+        return out
+
+    def _unpack_arg(self, packed):
+        kind = packed[0]
+        if kind == "v":
+            return serialization.loads(packed[1])
+        oid = ObjectID(packed[1])
+        ref = ObjectRef(oid, tuple(packed[2]) if packed[2] else None,
+                        _register=False)
+        return self._get_one(ref, None)
+
+    async def _rpc_push_actor_creation(self, actor_id: str,
+                                       creation_task: bytes):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self._execute_actor_creation, actor_id, creation_task
+        )
+
+    def _execute_actor_creation(self, actor_id: str, creation_task: bytes):
+        info = cloudpickle.loads(creation_task)
+        cls = cloudpickle.loads(info["cls"])
+        args = [self._unpack_arg(a) for a in info["args"]]
+        kwargs = {k: self._unpack_arg(v) for k, v in info["kwargs"].items()}
+        self.actor_instance = cls(*args, **kwargs)
+        self.actor_id = actor_id
+        self._max_concurrency = info.get("max_concurrency", 1)
+        self._actor_executor = ThreadPoolExecutor(
+            max_workers=self._max_concurrency
+        )
+        return {"ok": True, "address": list(self.address)}
+
+    async def _rpc_push_actor_task(self, spec: dict, seq: int, caller: str,
+                                   incarnation: int = 0):
+        """Ordered actor task execution (reference:
+        actor_scheduling_queue.cc): per-caller sequence numbers enforce
+        submission order; async-def methods interleave on the io loop
+        (reference async actors: fiber.h); sync methods run in a pool of
+        max_concurrency threads (threaded actors: thread_pool.cc).
+        With max_concurrency == 1, execution itself is serialized in seq
+        order; otherwise only *dispatch* is ordered."""
+        if self._actor_seq_cond is None:
+            self._actor_seq_cond = asyncio.Condition()
+        method = getattr(self.actor_instance, spec["method"], None)
+        is_async = method is not None and asyncio.iscoroutinefunction(method)
+        serialize_execution = self._max_concurrency == 1 and not is_async
+        # wait (on the loop, no thread blocked) until it's our turn
+        async with self._actor_seq_cond:
+            await self._actor_seq_cond.wait_for(
+                lambda: self._actor_next_seq[caller] >= seq
+            )
+            if not serialize_execution:
+                self._actor_next_seq[caller] = seq + 1
+                self._actor_seq_cond.notify_all()
+        loop = asyncio.get_running_loop()
+        try:
+            if method is None:
+                return self._actor_error_reply(
+                    spec,
+                    AttributeError(f"actor has no method {spec['method']!r}"),
+                )
+            if is_async:
+                # arg refs may need network fetches — never block the io
+                # loop resolving them (call_sync from the loop deadlocks)
+                try:
+                    args, kwargs = await loop.run_in_executor(
+                        self._task_executor,
+                        lambda: (
+                            [self._unpack_arg(a) for a in spec["args"]],
+                            {
+                                k: self._unpack_arg(v)
+                                for k, v in spec["kwargs"].items()
+                            },
+                        ),
+                    )
+                    result = await method(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001
+                    return self._actor_error_reply(spec, e)
+                return await loop.run_in_executor(
+                    self._task_executor,
+                    lambda: {
+                        "returns": self._pack_returns(spec, result),
+                        "node_id": self.node_id,
+                    },
+                )
+            return await loop.run_in_executor(
+                self._actor_executor, self._execute_actor_task_sync, spec
+            )
+        finally:
+            if serialize_execution:
+                async with self._actor_seq_cond:
+                    self._actor_next_seq[caller] = seq + 1
+                    self._actor_seq_cond.notify_all()
+
+    def _execute_actor_task_sync(self, spec: dict):
+        method = getattr(self.actor_instance, spec["method"])
+        args = [self._unpack_arg(a) for a in spec["args"]]
+        kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
+        try:
+            result = method(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            return self._actor_error_reply(spec, e)
+        return {
+            "returns": self._pack_returns(spec, result),
+            "node_id": self.node_id,
+        }
+
+    def _actor_error_reply(self, spec, e: Exception):
+        tb = traceback.format_exc()
+        err = serialization.dumps(
+            RayTaskError(f"{type(e).__name__}: {e}\n{tb}", type(e).__name__)
+        )
+        task_id = TaskID(spec["task_id"])
+        return {
+            "returns": [
+                (ObjectID.for_task_return(task_id, i).binary(), "err", err)
+                for i in range(spec["num_returns"])
+            ],
+            "node_id": self.node_id,
+        }
+
+    async def _rpc_exit_worker(self, reason: str = ""):
+        def _die():
+            time.sleep(0.05)
+            os._exit(0)
+
+        threading.Thread(target=_die, daemon=True).start()
+        return True
+
+    async def _rpc_cancel_task(self, task_id: bytes):
+        return False  # cooperative cancellation lands with generators
+
+    # ==================================================================
+    # task events (observability; flushed to GCS task-event store)
+    # ==================================================================
+    def _record_task_event(self, spec: dict, state: str):
+        with self._task_events_lock:
+            self._task_events.append(
+                {
+                    "task_id": spec["task_id"].hex()
+                    if isinstance(spec["task_id"], bytes)
+                    else spec["task_id"],
+                    "name": spec.get("name", ""),
+                    "job_id": spec.get("job_id"),
+                    "state": state,
+                    "ts": time.time(),
+                    "node_id": self.node_id,
+                }
+            )
+
+    async def _actor_event_loop(self):
+        """Long-poll the GCS ACTOR channel; feeds actor submitters so they
+        learn restarts/deaths without polling (reference: pubsub-driven
+        actor handle updates)."""
+        sub_id = f"cw-{self.worker_id}"
+        subscribed = False
+        while not self._exit.is_set():
+            try:
+                if not subscribed:
+                    await self.gcs.aio.call(
+                        "subscribe", sub_id=sub_id, channels=["ACTOR"]
+                    )
+                    subscribed = True
+                msgs = await self.gcs.aio.call(
+                    "poll", sub_id=sub_id, timeout_s=10.0, timeout=15.0
+                )
+                if msgs is None:
+                    subscribed = False
+                    continue
+                for _channel, msg in msgs:
+                    sub = self._actor_subs.get(msg.get("actor_id"))
+                    if sub is not None:
+                        sub.on_actor_event(msg)
+            except Exception:
+                await asyncio.sleep(0.5)
+
+    async def _flush_task_events_loop(self):
+        while not self._exit.is_set():
+            await asyncio.sleep(1.0)
+            with self._task_events_lock:
+                batch, self._task_events = self._task_events, []
+            if batch:
+                try:
+                    await self.gcs.aio.call("add_task_events", events=batch)
+                except Exception:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# Lease pool: one per scheduling class (reference: NormalTaskSubmitter's
+# per-SchedulingKey lease management, normal_task_submitter.h:79)
+# ---------------------------------------------------------------------------
+class _LeasePool:
+    MAX_LEASES_PER_CLASS = int(os.environ.get("RAY_TPU_MAX_LEASES", "64"))
+
+    def __init__(self, worker: CoreWorker, demand, strategy, params):
+        self.worker = worker
+        self.demand = demand
+        self.strategy = strategy
+        self.params = params or {}
+        self.queue: collections.deque = collections.deque()
+        self.free_leases: collections.deque = collections.deque()
+        self.num_leases = 0
+        self.pending_lease_requests = 0
+        self.lock = threading.Lock()
+
+    def enqueue(self, spec: dict):
+        loop = EventLoopThread.get()
+        with self.lock:
+            self.queue.append(spec)
+        loop.spawn(self._pump())
+
+    async def _pump(self):
+        while True:
+            with self.lock:
+                if not self.queue:
+                    # Return surplus leases so their resources free up
+                    # (worker processes stay warm in the raylet's idle pool,
+                    # so the next burst re-leases without a spawn).
+                    while self.free_leases:
+                        lease = self.free_leases.popleft()
+                        self.num_leases -= 1
+                        asyncio.ensure_future(self._return_lease(lease, ok=True))
+                    return
+                if self.free_leases:
+                    lease = self.free_leases.popleft()
+                    spec = self.queue.popleft()
+                else:
+                    if (
+                        self.num_leases + self.pending_lease_requests
+                        < min(len(self.queue), self.MAX_LEASES_PER_CLASS)
+                        or self.num_leases + self.pending_lease_requests == 0
+                    ):
+                        self.pending_lease_requests += 1
+                        asyncio.ensure_future(self._request_lease())
+                    return
+            asyncio.ensure_future(self._dispatch(lease, spec))
+
+    async def _request_lease(self, address: Optional[tuple] = None):
+        w = self.worker
+        try:
+            cli = (
+                w.raylet
+                if address is None
+                else w._pool.get(address[0], int(address[1]))
+            )
+            allow_spill = True
+            if address is None and self.strategy == "SPREAD":
+                # Round-robin lease requests over alive nodes (reference:
+                # spread_scheduling_policy.cc).
+                view = await w.gcs.aio.call("get_cluster_view")
+                alive = sorted(
+                    nid for nid, v in view.items() if v.get("alive")
+                )
+                if alive:
+                    self._spread_cursor = (
+                        getattr(self, "_spread_cursor", -1) + 1
+                    ) % len(alive)
+                    cli = w._pool.get(
+                        *view[alive[self._spread_cursor]]["address"]
+                    )
+            target = self.params.get("node_id")
+            if address is None and target is not None:
+                # NodeAffinity: lease directly from the target node's raylet
+                # (reference: node_affinity_scheduling_policy.cc).
+                view = await w.gcs.aio.call("get_cluster_view")
+                node = view.get(target)
+                if node is None or not node.get("alive"):
+                    if not self.params.get("soft"):
+                        with self.lock:
+                            self.pending_lease_requests -= 1
+                        self._fail_all(
+                            RayError(f"affinity node {target} is gone")
+                        )
+                        return
+                else:
+                    cli = w._pool.get(*node["address"])
+                    allow_spill = bool(self.params.get("soft"))
+            reply = await cli.call(
+                "lease_worker",
+                demand=self.demand,
+                lease_type="task",
+                placement_group_id=self.params.get("placement_group_id"),
+                bundle_index=self.params.get("bundle_index", -1),
+                allow_spill=allow_spill,
+            )
+        except Exception:
+            with self.lock:
+                self.pending_lease_requests -= 1
+            await asyncio.sleep(0.2)
+            asyncio.ensure_future(self._pump())
+            return
+        if reply.get("ok"):
+            lease = reply
+            with self.lock:
+                self.pending_lease_requests -= 1
+                self.num_leases += 1
+                self.free_leases.append(lease)
+            asyncio.ensure_future(self._pump())
+            return
+        spill = reply.get("spill_to")
+        if spill is not None:
+            # retry at the suggested node (reference spillback).
+            await self._request_lease_at(spill)
+            return
+        with self.lock:
+            self.pending_lease_requests -= 1
+        if reply.get("infeasible"):
+            # Possibly just a stale cluster view (a node that fits may not
+            # have gossiped yet). Reference semantics: infeasible tasks WAIT
+            # in the queue until resources appear (with a warning).
+            await asyncio.sleep(1.0)
+            asyncio.ensure_future(self._pump())
+            return
+        await asyncio.sleep(0.2)
+        asyncio.ensure_future(self._pump())
+
+    async def _request_lease_at(self, spill):
+        _node_id, address = spill
+        with self.lock:
+            self.pending_lease_requests -= 1
+            self.pending_lease_requests += 1
+        try:
+            cli = self.worker._pool.get(address[0], int(address[1]))
+            reply = await cli.call(
+                "lease_worker",
+                demand=self.demand,
+                lease_type="task",
+                allow_spill=False,
+            )
+        except Exception:
+            reply = {"ok": False}
+        with self.lock:
+            self.pending_lease_requests -= 1
+            if reply.get("ok"):
+                self.num_leases += 1
+                self.free_leases.append(reply)
+        asyncio.ensure_future(self._pump())
+
+    def _fail_all(self, error: Exception):
+        with self.lock:
+            specs = list(self.queue)
+            self.queue.clear()
+        retry = [s for s in specs if self.worker._on_task_failed(s, error)]
+        if retry:
+            with self.lock:
+                self.queue.extend(retry)
+            EventLoopThread.get().spawn(self._pump())
+
+    async def _dispatch(self, lease: dict, spec: dict):
+        w = self.worker
+        addr = lease["worker_address"]
+        cli = w._pool.get(addr[0], int(addr[1]))
+        try:
+            reply = await cli.call("push_task", spec=spec)
+        except (RpcConnectionError, RpcApplicationError) as e:
+            with self.lock:
+                self.num_leases -= 1
+            await self._return_lease(lease, ok=False)
+            if w._on_task_failed(spec, e):
+                self.enqueue(spec)
+            asyncio.ensure_future(self._pump())
+            return
+        w._on_task_done(spec, reply["returns"], reply["node_id"])
+        with self.lock:
+            # SPREAD leases are single-use: reuse would pin the whole burst
+            # to whichever node answered first (reference: spread policy
+            # places per task, not per lease).
+            if self.queue and self.strategy != "SPREAD":
+                self.free_leases.append(lease)
+            else:
+                self.num_leases -= 1
+                asyncio.ensure_future(self._return_lease(lease, ok=True))
+        asyncio.ensure_future(self._pump())
+
+    async def _return_lease(self, lease: dict, ok: bool):
+        w = self.worker
+        # Return to the raylet that granted it (node_id in lease).
+        try:
+            view = await w.gcs.aio.call("get_cluster_view")
+            node = view.get(lease.get("node_id"))
+            cli = (
+                w.raylet
+                if node is None
+                else w._pool.get(*node["address"])
+            )
+            await cli.call("return_worker", lease_id=lease["lease_id"], ok=ok)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Actor submitter (reference: actor_task_submitter.h:75)
+# ---------------------------------------------------------------------------
+class _ActorSubmitter:
+    def __init__(self, worker: CoreWorker, actor_id: str,
+                 max_task_retries: int = 0):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.max_task_retries = max_task_retries
+        self.state = "PENDING"
+        self.address: Optional[tuple] = None
+        self.incarnation = 0
+        self.seq = 0
+        self.queue: collections.deque = collections.deque()
+        self.lock = threading.Lock()
+        self._resolving = False
+
+    def enqueue(self, spec: dict):
+        with self.lock:
+            spec.setdefault("_retries", self.max_task_retries)
+            self.queue.append(spec)
+        EventLoopThread.get().spawn(self._pump())
+
+    async def _pump(self):
+        with self.lock:
+            if self.state == "DEAD":
+                self._fail_queue("actor is dead")
+                return
+            if self.address is None:
+                if not self._resolving:
+                    self._resolving = True
+                    asyncio.ensure_future(self._resolve_address())
+                return
+            specs = list(self.queue)
+            self.queue.clear()
+            # Sequence numbers are assigned at dispatch, scoped to the
+            # current incarnation (a restarted actor starts expecting 0).
+            for spec in specs:
+                spec["_seq"] = self.seq
+                self.seq += 1
+        for spec in specs:
+            asyncio.ensure_future(self._send(spec))
+
+    async def _resolve_address(self):
+        w = self.worker
+        backoff = 0.02
+        try:
+            while True:
+                try:
+                    info = await w.gcs.aio.call(
+                        "get_actor_info", actor_id=self.actor_id
+                    )
+                except Exception:
+                    info = None
+                if info is None:
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 1.0)
+                    continue
+                state = info["state"]
+                if state == "ALIVE" and info.get("address"):
+                    with self.lock:
+                        new_addr = tuple(info["address"])
+                        if (
+                            info.get("restarts", 0) != self.incarnation
+                            or new_addr != self.address
+                        ):
+                            # fresh incarnation: its seq expectations reset
+                            self.incarnation = info.get("restarts", 0)
+                            self.seq = 0
+                        self.address = new_addr
+                        self.state = "ALIVE"
+                    break
+                if state == "DEAD":
+                    with self.lock:
+                        self.state = "DEAD"
+                        self._fail_queue(
+                            f"actor died: {info.get('death_cause')}"
+                        )
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 1.0)
+        finally:
+            with self.lock:
+                self._resolving = False
+        await self._pump()
+
+    def _fail_queue(self, reason: str):
+        specs = list(self.queue)
+        self.queue.clear()
+        err = serialization.dumps(RayActorError(reason))
+        for spec in specs:
+            self._fail_spec(spec, err)
+
+    def _fail_spec(self, spec, err: bytes):
+        w = self.worker
+        task_id = TaskID(spec["task_id"])
+        with w._records_lock:
+            for i in range(spec["num_returns"]):
+                oid = ObjectID.for_task_return(task_id, i)
+                rec = w._records.get(oid.binary())
+                if rec is not None:
+                    rec.pending = False
+                    rec.error = err
+                    rec.event.set()
+                    if rec.local_refs <= 0 and rec.borrowers <= 0:
+                        w._free_object(oid, rec)
+            task = w._tasks.get(spec["task_id"])
+        if task is not None:
+            retained, task.retained = task.retained, []
+            for oid in retained:
+                w._release_ref(oid)
+
+    async def _send(self, spec: dict):
+        w = self.worker
+        addr = self.address
+        if addr is None:
+            with self.lock:
+                self.queue.append(spec)
+            await self._pump()
+            return
+        cli = w._pool.get(*addr)
+        try:
+            reply = await cli.call(
+                "push_actor_task", spec={k: v for k, v in spec.items()
+                                         if not k.startswith("_")},
+                seq=spec["_seq"], caller=w.worker_id,
+                incarnation=self.incarnation,
+            )
+        except RpcApplicationError as e:
+            self._fail_spec(spec, serialization.dumps(
+                RayTaskError(str(e), "RpcApplicationError")))
+            return
+        except (RpcConnectionError, Exception) as e:  # actor process gone
+            with self.lock:
+                self.address = None
+                self.state = "PENDING"
+            if spec.get("_retries", 0) > 0:
+                spec["_retries"] -= 1
+                with self.lock:
+                    self.queue.append(spec)
+                await self._pump()
+            else:
+                self._fail_spec(
+                    spec,
+                    serialization.dumps(
+                        RayActorError(
+                            f"actor task failed: {type(e).__name__}: {e}"
+                        )
+                    ),
+                )
+            return
+        w._on_task_done(spec, reply["returns"], reply["node_id"])
+
+    def on_actor_event(self, event: dict):
+        """Wired to the GCS ACTOR pubsub channel."""
+        kind = event.get("event")
+        with self.lock:
+            if kind == "alive":
+                new_addr = tuple(event["address"])
+                if new_addr != self.address:
+                    self.seq = 0
+                self.address = new_addr
+                self.state = "ALIVE"
+            elif kind == "restarting":
+                self.address = None
+                self.state = "PENDING"
+                self.incarnation += 1
+            elif kind == "dead":
+                self.state = "DEAD"
+                self.address = None
+                self._fail_queue(f"actor died: {event.get('reason')}")
+        EventLoopThread.get().spawn(self._pump())

@@ -1,0 +1,734 @@
+"""GCS — the head-node control plane (Global Control Service).
+
+Reference: src/ray/gcs/gcs_server/ — GcsServer (gcs_server.cc:165) composing
+node / resource / health / job / placement-group / actor / worker / task
+managers over an in-memory store, with long-poll pubsub
+(src/ray/pubsub/publisher.h:300) notifying clients of node/actor/job events.
+
+This implementation keeps the same managers as asyncio objects in one process:
+  - NodeManager + ResourceManager: node table + per-heartbeat resource view
+    (the heartbeat reply carries the full cluster view — collapsing the
+    reference's separate RaySyncer gossip stream, ray_syncer.h:83, into the
+    existing 1 Hz heartbeat round-trip).
+  - HealthCheckManager: misses N heartbeats => node dead (reference:
+    gcs_health_check_manager.h:45).
+  - ActorManager + ActorScheduler: pending queue -> pick node (hybrid policy)
+    -> lease worker from that raylet -> push creation task to the worker
+    (reference: gcs_actor_manager.h:333, gcs_actor_scheduler.h:115).
+  - PlacementGroupManager: 2-phase bundle reservation (prepare/commit) across
+    raylets (reference: gcs_placement_group_scheduler 2PC).
+  - JobManager, WorkerManager, internal KV, function-export KV, pubsub,
+    task-event store (reference: gcs_task_manager.h:94).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import Config, get_config, set_config
+from .rpc import ClientPool, EventLoopThread, RpcClient, RpcServer
+from .scheduling import (
+    ClusterResourceScheduler,
+    NodeView,
+    SchedulingRequest,
+    pack_bundles,
+)
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = RpcServer(host, port)
+        self._server.register(self)
+        self._pool = ClientPool()
+        cfg = get_config()
+        self._hb_period = cfg.health_check_period_s
+        self._hb_threshold = cfg.health_check_failure_threshold
+
+        # node table: node_id -> info dict
+        self._nodes: Dict[str, dict] = {}
+        self._node_views: Dict[str, NodeView] = {}
+        self._last_heartbeat: Dict[str, float] = {}
+
+        # kv: namespace -> key -> bytes
+        self._kv: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
+
+        # actors
+        self._actors: Dict[str, dict] = {}  # actor_id -> record
+        self._named_actors: Dict[Tuple[str, str], str] = {}
+        self._pending_actors: collections.deque = collections.deque()
+        self._actor_wakeup = asyncio.Event()
+
+        # placement groups
+        self._pgs: Dict[str, dict] = {}
+        self._pending_pgs: collections.deque = collections.deque()
+
+        # jobs
+        self._jobs: Dict[str, dict] = {}
+
+        # pubsub
+        self._subscribers: Dict[str, dict] = {}  # sub_id -> {channels, queue, event}
+
+        # task events (observability; reference gcs_task_manager.h:94)
+        self._task_events: collections.deque = collections.deque(
+            maxlen=cfg.task_events_max_buffer_size
+        )
+
+        self._started = time.time()
+        self._bg_tasks: List[asyncio.Task] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    async def start(self):
+        await self._server.start()
+        self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
+        self._bg_tasks.append(asyncio.ensure_future(self._scheduling_loop()))
+
+    async def stop(self):
+        for t in self._bg_tasks:
+            t.cancel()
+        await self._server.stop()
+
+    @property
+    def address(self):
+        return self._server.address
+
+    # ------------------------------------------------------------------
+    # pubsub (reference: src/ray/pubsub — long-poll publisher)
+    # ------------------------------------------------------------------
+    def _publish(self, channel: str, msg: Any):
+        for sub in self._subscribers.values():
+            if channel in sub["channels"]:
+                sub["queue"].append((channel, msg))
+                sub["event"].set()
+
+    async def subscribe(self, sub_id: str, channels: List[str]):
+        self._subscribers[sub_id] = {
+            "channels": set(channels),
+            "queue": collections.deque(maxlen=100000),
+            "event": asyncio.Event(),
+        }
+        return True
+
+    async def unsubscribe(self, sub_id: str):
+        self._subscribers.pop(sub_id, None)
+        return True
+
+    async def poll(self, sub_id: str, timeout_s: float = 10.0):
+        sub = self._subscribers.get(sub_id)
+        if sub is None:
+            return None  # tells client to re-subscribe
+        if not sub["queue"]:
+            sub["event"].clear()
+            try:
+                await asyncio.wait_for(sub["event"].wait(), timeout_s)
+            except asyncio.TimeoutError:
+                return []
+        out = list(sub["queue"])
+        sub["queue"].clear()
+        return out
+
+    async def publish(self, channel: str, msg: Any):
+        self._publish(channel, msg)
+        return True
+
+    # ------------------------------------------------------------------
+    # KV (reference: gcs_kv_manager.h; used for function exports, serve
+    # config, cluster metadata)
+    # ------------------------------------------------------------------
+    async def kv_put(self, ns: str, key: str, value: bytes, overwrite: bool = True):
+        table = self._kv[ns]
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    async def kv_get(self, ns: str, key: str):
+        return self._kv[ns].get(key)
+
+    async def kv_multi_get(self, ns: str, keys: List[str]):
+        table = self._kv[ns]
+        return {k: table[k] for k in keys if k in table}
+
+    async def kv_del(self, ns: str, key: str):
+        return self._kv[ns].pop(key, None) is not None
+
+    async def kv_exists(self, ns: str, key: str):
+        return key in self._kv[ns]
+
+    async def kv_keys(self, ns: str, prefix: str = ""):
+        return [k for k in self._kv[ns] if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # nodes + resources + health
+    # ------------------------------------------------------------------
+    async def register_node(self, info: dict):
+        node_id = info["node_id"]
+        self._nodes[node_id] = info
+        self._node_views[node_id] = NodeView(
+            node_id=node_id,
+            address=tuple(info["address"]),
+            total=dict(info.get("resources", {})),
+            available=dict(info.get("resources", {})),
+            labels=dict(info.get("labels", {})),
+        )
+        self._last_heartbeat[node_id] = time.time()
+        self._publish("NODE", {"event": "added", "node": info})
+        self._kick_schedulers()
+        return True
+
+    async def unregister_node(self, node_id: str, reason: str = "graceful"):
+        self._handle_node_death(node_id, reason)
+        return True
+
+    async def drain_node(self, node_id: str):
+        v = self._node_views.get(node_id)
+        if v is not None:
+            v.draining = True
+        return True
+
+    async def get_all_nodes(self):
+        out = []
+        for nid, info in self._nodes.items():
+            v = self._node_views[nid]
+            out.append(
+                {
+                    **info,
+                    "alive": v.alive,
+                    "available": v.available,
+                    "total": v.total,
+                }
+            )
+        return out
+
+    async def heartbeat(
+        self,
+        node_id: str,
+        available: Dict[str, float],
+        idle_duration_s: float = 0.0,
+    ):
+        """Resource report; reply carries the full cluster view (syncer)."""
+        v = self._node_views.get(node_id)
+        if v is None:
+            return None  # unknown node: tells raylet to re-register
+        self._last_heartbeat[node_id] = time.time()
+        old_avail = v.available
+        v.available = dict(available)
+        if old_avail != v.available:
+            self._kick_schedulers()
+        return self._cluster_view()
+
+    def _cluster_view(self):
+        return {
+            nid: {
+                "address": v.address,
+                "total": v.total,
+                "available": v.available,
+                "labels": v.labels,
+                "alive": v.alive,
+                "object_manager_address": self._nodes[nid].get(
+                    "object_manager_address"
+                ),
+            }
+            for nid, v in self._node_views.items()
+        }
+
+    async def get_cluster_view(self):
+        return self._cluster_view()
+
+    async def _health_check_loop(self):
+        while True:
+            await asyncio.sleep(self._hb_period)
+            deadline = time.time() - self._hb_period * self._hb_threshold
+            for nid, v in list(self._node_views.items()):
+                if v.alive and self._last_heartbeat.get(nid, 0) < deadline:
+                    self._handle_node_death(nid, "heartbeat timeout")
+
+    def _handle_node_death(self, node_id: str, reason: str):
+        v = self._node_views.get(node_id)
+        if v is None or not v.alive:
+            return
+        v.alive = False
+        v.available = {}
+        self._publish("NODE", {"event": "removed", "node_id": node_id, "reason": reason})
+        # Actors on the dead node die (and maybe restart).
+        for aid, rec in list(self._actors.items()):
+            if rec.get("node_id") == node_id and rec["state"] in (ALIVE, PENDING_CREATION, RESTARTING):
+                self._on_actor_interrupted(aid, f"node {node_id} died: {reason}")
+        # PGs with bundles on the dead node are rescheduled.
+        for pgid, pg in self._pgs.items():
+            if pg["state"] == "CREATED" and node_id in (pg.get("placement") or []):
+                pg["state"] = "RESCHEDULING"
+                self._pending_pgs.append(pgid)
+        self._kick_schedulers()
+
+    def _kick_schedulers(self):
+        self._actor_wakeup.set()
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+    async def add_job(self, job_info: dict):
+        self._jobs[job_info["job_id"]] = {**job_info, "state": "RUNNING",
+                                          "start_time": time.time()}
+        self._publish("JOB", {"event": "added", "job": job_info})
+        return True
+
+    async def mark_job_finished(self, job_id: str):
+        job = self._jobs.get(job_id)
+        if job is not None:
+            job["state"] = "FINISHED"
+            job["end_time"] = time.time()
+        # Kill non-detached actors belonging to the job.
+        for aid, rec in list(self._actors.items()):
+            if rec["job_id"] == job_id and not rec.get("detached"):
+                await self._kill_actor_internal(aid, no_restart=True,
+                                                reason="job finished")
+        self._publish("JOB", {"event": "finished", "job_id": job_id})
+        return True
+
+    async def get_all_jobs(self):
+        return list(self._jobs.values())
+
+    # ------------------------------------------------------------------
+    # actors (reference: gcs_actor_manager.h:333 + gcs_actor_scheduler.h:115)
+    # ------------------------------------------------------------------
+    async def register_actor(self, spec: dict):
+        """spec: actor_id, job_id, name, namespace, demand, strategy fields,
+        creation_task (opaque bytes pushed to the leased worker), owner
+        address, max_restarts, detached, labels."""
+        aid = spec["actor_id"]
+        name = spec.get("name")
+        if name:
+            key = (spec.get("namespace", ""), name)
+            if key in self._named_actors:
+                existing = self._named_actors[key]
+                if self._actors[existing]["state"] != DEAD:
+                    return {"ok": False, "error": f"actor name '{name}' taken"}
+            self._named_actors[key] = aid
+        rec = {
+            **spec,
+            "state": PENDING_CREATION,
+            "restarts": 0,
+            "node_id": None,
+            "worker_id": None,
+            "address": None,
+            "death_cause": None,
+        }
+        self._actors[aid] = rec
+        self._pending_actors.append(aid)
+        self._kick_schedulers()
+        return {"ok": True}
+
+    async def _scheduling_loop(self):
+        """Single loop driving both PG and actor placement (PGs first, since
+        actors may be waiting on a bundle)."""
+        while True:
+            await self._actor_wakeup.wait()
+            self._actor_wakeup.clear()
+            retry_pg: List[str] = []
+            while self._pending_pgs:
+                pgid = self._pending_pgs.popleft()
+                pg = self._pgs.get(pgid)
+                if pg is None or pg["state"] not in ("PENDING", "RESCHEDULING"):
+                    continue
+                if not await self._try_schedule_pg(pgid, pg):
+                    retry_pg.append(pgid)
+            self._pending_pgs.extend(retry_pg)
+            retry: List[str] = []
+            while self._pending_actors:
+                aid = self._pending_actors.popleft()
+                rec = self._actors.get(aid)
+                if rec is None or rec["state"] not in (PENDING_CREATION, RESTARTING):
+                    continue
+                ok = await self._try_schedule_actor(aid, rec)
+                if not ok:
+                    retry.append(aid)
+            self._pending_actors.extend(retry)
+            if retry or retry_pg:
+                await asyncio.sleep(0.2)
+                self._actor_wakeup.set()
+
+    async def _try_schedule_actor(self, aid: str, rec: dict) -> bool:
+        sched = ClusterResourceScheduler(
+            spread_threshold=get_config().scheduler_spread_threshold
+        )
+        req = SchedulingRequest(
+            demand=rec.get("demand", {}),
+            strategy=rec.get("strategy", "DEFAULT"),
+            affinity_node_id=rec.get("affinity_node_id"),
+            affinity_soft=rec.get("affinity_soft", False),
+            label_selector=rec.get("label_selector", {}),
+        )
+        # Placement-group bundle pins the actor to the bundle's node.
+        pg_id = rec.get("placement_group_id")
+        if pg_id:
+            pg = self._pgs.get(pg_id)
+            if pg is None or pg["state"] != "CREATED":
+                return False
+            idx = rec.get("placement_group_bundle_index", 0)
+            if idx == -1:
+                idx = 0
+            req.strategy = "NodeAffinity"
+            req.affinity_node_id = pg["placement"][idx]
+            req.affinity_soft = False
+        node_id = sched.pick_node(self._node_views, req)
+        if node_id is None:
+            return False
+        # Lease + creation run off the scheduling loop entirely (worker
+        # spawn and user constructors can take seconds; one slow node must
+        # not block other actors head-of-line).
+        asyncio.ensure_future(self._lease_and_create_actor(aid, rec, node_id,
+                                                           pg_id))
+        return True
+
+    async def _lease_and_create_actor(self, aid, rec, node_id, pg_id):
+        view = self._node_views.get(node_id)
+        if view is None:
+            self._requeue_actor(aid)
+            return
+        raylet = self._pool.get(*view.address)
+        try:
+            # wait=False: a stale view must not park the lease at a busy
+            # raylet; an unlucky pick just retries next round.
+            lease = await raylet.call(
+                "lease_worker",
+                demand=rec.get("demand", {}),
+                lease_type="actor",
+                task_id=aid,
+                runtime_env=rec.get("runtime_env"),
+                placement_group_id=pg_id,
+                bundle_index=rec.get("placement_group_bundle_index", -1),
+                wait=False,
+                timeout=get_config().worker_register_timeout_s + 10.0,
+            )
+        except Exception:
+            lease = None
+        if not lease or not lease.get("ok"):
+            await asyncio.sleep(0.2)
+            self._requeue_actor(aid)
+            return
+        worker_addr = tuple(lease["worker_address"])
+        rec.update(
+            node_id=node_id,
+            worker_id=lease["worker_id"],
+            address=worker_addr,
+        )
+        await self._finish_actor_creation(aid, rec, raylet, lease,
+                                          worker_addr, node_id)
+
+    def _requeue_actor(self, aid: str):
+        rec = self._actors.get(aid)
+        if rec is not None and rec["state"] in (PENDING_CREATION, RESTARTING):
+            self._pending_actors.append(aid)
+            self._kick_schedulers()
+
+    async def _finish_actor_creation(self, aid, rec, raylet, lease,
+                                     worker_addr, node_id):
+        try:
+            worker = self._pool.get(*worker_addr)
+            await worker.call(
+                "push_actor_creation",
+                actor_id=aid,
+                creation_task=rec["creation_task"],
+            )
+        except Exception as e:
+            try:
+                await raylet.call("return_worker", worker_id=lease["worker_id"],
+                                  ok=False)
+            except Exception:
+                pass
+            rec["death_cause"] = f"creation failed: {e}"
+            self._on_actor_interrupted(aid, rec["death_cause"])
+            return
+        if rec["state"] == DEAD:
+            return  # killed while constructing
+        rec["state"] = ALIVE
+        self._publish("ACTOR", {"event": "alive", "actor_id": aid,
+                                "address": worker_addr,
+                                "node_id": node_id})
+
+    def _on_actor_interrupted(self, aid: str, reason: str):
+        rec = self._actors[aid]
+        max_restarts = rec.get("max_restarts", 0)
+        if rec["state"] == DEAD:
+            return
+        if max_restarts == -1 or rec["restarts"] < max_restarts:
+            rec["restarts"] += 1
+            rec["state"] = RESTARTING
+            rec["address"] = None
+            self._publish("ACTOR", {"event": "restarting", "actor_id": aid,
+                                    "reason": reason})
+            self._pending_actors.append(aid)
+            self._kick_schedulers()
+        else:
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            self._publish("ACTOR", {"event": "dead", "actor_id": aid,
+                                    "reason": reason})
+
+    async def report_actor_death(self, actor_id: str, reason: str,
+                                 expected: bool = False):
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return False
+        if expected:
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            self._publish("ACTOR", {"event": "dead", "actor_id": actor_id,
+                                    "reason": reason})
+        else:
+            self._on_actor_interrupted(actor_id, reason)
+        return True
+
+    async def report_worker_failure(self, node_id: str, worker_id: str,
+                                    reason: str = "worker died"):
+        for aid, rec in list(self._actors.items()):
+            if rec.get("worker_id") == worker_id and rec["state"] in (
+                ALIVE, PENDING_CREATION
+            ):
+                self._on_actor_interrupted(aid, reason)
+        self._publish("WORKER", {"event": "failed", "node_id": node_id,
+                                 "worker_id": worker_id, "reason": reason})
+        return True
+
+    async def get_actor_info(self, actor_id: str):
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return None
+        return {k: v for k, v in rec.items() if k != "creation_task"}
+
+    async def get_named_actor(self, name: str, namespace: str = ""):
+        aid = self._named_actors.get((namespace, name))
+        if aid is None:
+            return None
+        return await self.get_actor_info(aid)
+
+    async def list_named_actors(self, namespace: str = ""):
+        return [
+            {"name": name, "actor_id": aid, "namespace": ns}
+            for (ns, name), aid in self._named_actors.items()
+            if not namespace or ns == namespace
+        ]
+
+    async def get_all_actors(self):
+        return [
+            {k: v for k, v in rec.items() if k != "creation_task"}
+            for rec in self._actors.values()
+        ]
+
+    async def kill_actor(self, actor_id: str, no_restart: bool = True):
+        return await self._kill_actor_internal(actor_id, no_restart,
+                                               "ray.kill")
+
+    async def _kill_actor_internal(self, actor_id: str, no_restart: bool,
+                                   reason: str):
+        rec = self._actors.get(actor_id)
+        if rec is None:
+            return False
+        if no_restart:
+            rec["max_restarts"] = rec["restarts"]  # exhaust restarts
+        addr = rec.get("address")
+        if rec["state"] == ALIVE and addr:
+            try:
+                worker = self._pool.get(*addr)
+                await worker.call("exit_worker", reason=reason, timeout=2.0)
+            except Exception:
+                pass
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            self._publish("ACTOR", {"event": "dead", "actor_id": actor_id,
+                                    "reason": reason})
+        elif no_restart:
+            rec["state"] = DEAD
+            rec["death_cause"] = reason
+            self._publish("ACTOR", {"event": "dead", "actor_id": actor_id,
+                                    "reason": reason})
+        return True
+
+    # ------------------------------------------------------------------
+    # placement groups (2-phase commit across raylets)
+    # ------------------------------------------------------------------
+    async def create_placement_group(self, spec: dict):
+        """spec: pg_id, job_id, name, bundles: [ResourceSet], strategy,
+        detached."""
+        pgid = spec["pg_id"]
+        self._pgs[pgid] = {
+            **spec,
+            "state": "PENDING",
+            "placement": None,
+        }
+        self._pending_pgs.append(pgid)
+        self._kick_schedulers()
+        return {"ok": True}
+
+    async def _try_schedule_pg(self, pgid: str, pg: dict) -> bool:
+        placement = pack_bundles(
+            self._node_views, pg["bundles"], pg.get("strategy", "PACK")
+        )
+        if placement is None:
+            return False
+        # phase 1: prepare on each raylet
+        prepared: List[Tuple[str, int]] = []
+        ok = True
+        for idx, nid in enumerate(placement):
+            raylet = self._pool.get(*self._node_views[nid].address)
+            try:
+                r = await raylet.call(
+                    "prepare_bundle", pg_id=pgid, bundle_index=idx,
+                    resources=pg["bundles"][idx],
+                )
+                if not r:
+                    ok = False
+                    break
+                prepared.append((nid, idx))
+            except Exception:
+                ok = False
+                break
+        if not ok:
+            for nid, idx in prepared:
+                try:
+                    await self._pool.get(*self._node_views[nid].address).call(
+                        "release_bundle", pg_id=pgid, bundle_index=idx
+                    )
+                except Exception:
+                    pass
+            return False
+        # phase 2: commit
+        for idx, nid in enumerate(placement):
+            try:
+                await self._pool.get(*self._node_views[nid].address).call(
+                    "commit_bundle", pg_id=pgid, bundle_index=idx
+                )
+            except Exception:
+                pass
+        pg["placement"] = placement
+        pg["state"] = "CREATED"
+        self._publish("PG", {"event": "created", "pg_id": pgid,
+                             "placement": placement})
+        self._kick_schedulers()  # unblock actors waiting on this PG
+        return True
+
+    async def remove_placement_group(self, pg_id: str):
+        pg = self._pgs.get(pg_id)
+        if pg is None:
+            return False
+        if pg.get("placement"):
+            for idx, nid in enumerate(pg["placement"]):
+                view = self._node_views.get(nid)
+                if view is None or not view.alive:
+                    continue
+                try:
+                    await self._pool.get(*view.address).call(
+                        "release_bundle", pg_id=pg_id, bundle_index=idx
+                    )
+                except Exception:
+                    pass
+        pg["state"] = "REMOVED"
+        self._publish("PG", {"event": "removed", "pg_id": pg_id})
+        return True
+
+    async def get_placement_group(self, pg_id: str):
+        return self._pgs.get(pg_id)
+
+    async def get_all_placement_groups(self):
+        return list(self._pgs.values())
+
+    # ------------------------------------------------------------------
+    # task events (observability; reference: gcs_task_manager.h:94)
+    # ------------------------------------------------------------------
+    async def add_task_events(self, events: List[dict]):
+        self._task_events.extend(events)
+        return True
+
+    async def get_task_events(self, job_id: Optional[str] = None,
+                              limit: int = 10000):
+        out = [
+            e for e in self._task_events
+            if job_id is None or e.get("job_id") == job_id
+        ]
+        return out[-limit:]
+
+    # ------------------------------------------------------------------
+    # cluster status (for `status` CLI / autoscaler)
+    # ------------------------------------------------------------------
+    async def get_cluster_status(self):
+        return {
+            "uptime_s": time.time() - self._started,
+            "nodes": await self.get_all_nodes(),
+            "num_actors": len(self._actors),
+            "num_pending_actors": len(self._pending_actors),
+            "num_pgs": len(self._pgs),
+            "jobs": list(self._jobs.values()),
+        }
+
+    async def ping(self):
+        return "pong"
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+class GcsClient:
+    """Sync facade over the GCS RPC surface (reference: gcs_client.h:92)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._client = RpcClient(host, port)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def _call(**kwargs):
+            timeout = kwargs.pop("timeout", None)
+            return self._client.call_sync(name, timeout=timeout, **kwargs)
+
+        return _call
+
+    @property
+    def aio(self) -> RpcClient:
+        return self._client
+
+    def close(self):
+        self._client.close_sync()
+
+
+# ---------------------------------------------------------------------------
+# process entry point
+# ---------------------------------------------------------------------------
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--config", default=None)
+    args = parser.parse_args()
+    if args.config:
+        set_config(Config.from_json(args.config))
+
+    async def run():
+        server = GcsServer(args.host, args.port)
+        await server.start()
+        print(f"GCS listening on {server.address}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

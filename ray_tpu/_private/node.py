@@ -1,0 +1,201 @@
+"""Node bootstrap: spawn GCS + raylet processes, connect drivers.
+
+Reference: python/ray/_private/node.py (start_ray_processes :1455) and
+services.py (start_gcs_server :1442, start_raylet :1526). A head node runs
+the GCS and a raylet; worker nodes run just a raylet pointed at the head's
+GCS. Drivers connect a CoreWorker to their local raylet + the GCS.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+from .config import get_config
+from .core_worker import CoreWorker
+from .gcs import GcsClient
+from .ids import JobID
+from .rpc import find_free_port
+
+
+def _wait_for_line(proc: subprocess.Popen, marker: str, timeout: float = 30.0):
+    """Read stdout lines until one starts with ``marker``."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"process exited with code {proc.returncode} before ready"
+            )
+        line = proc.stdout.readline().decode()
+        if not line:
+            time.sleep(0.01)
+            continue
+        if line.startswith(marker):
+            return line[len(marker):].strip()
+    raise TimeoutError(f"timed out waiting for {marker!r}")
+
+
+def _subprocess_env() -> dict:
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    # Daemons never touch jax; skip the TPU runtime hook (saves ~2s per
+    # process start and leaves the chip claimable by actual TPU workers).
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def start_gcs_server(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen, Tuple[str, int]]:
+    port = port or find_free_port()
+    log = open(os.path.join(session_dir, "logs", "gcs.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.gcs",
+            "--port", str(port),
+            "--config", get_config().to_json(),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=log,
+        env=_subprocess_env(),
+    )
+    _wait_for_line(proc, "GCS listening")
+    log.close()
+    return proc, ("127.0.0.1", port)
+
+
+def start_raylet(
+    session_dir: str,
+    gcs_address: Tuple[str, int],
+    *,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    is_head: bool = False,
+) -> Tuple[subprocess.Popen, dict]:
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.raylet",
+            "--gcs-host", gcs_address[0],
+            "--gcs-port", str(gcs_address[1]),
+            "--session-dir", session_dir,
+            "--config", get_config().to_json(),
+            "--resources", json.dumps(resources) if resources else "",
+            "--labels", json.dumps(labels) if labels else "",
+        ]
+        + (["--is-head"] if is_head else []),
+        stdout=subprocess.PIPE,
+        stderr=open(os.path.join(session_dir, "logs", "raylet.err"), "ab"),
+        env=_subprocess_env(),
+    )
+    info = json.loads(_wait_for_line(proc, "RAYLET_READY"))
+    return proc, info
+
+
+def connect_driver(
+    *,
+    node_id: str,
+    raylet_address: Tuple[str, int],
+    gcs_address: Tuple[str, int],
+    arena_path: str,
+    session_dir: str,
+    job_id: Optional[JobID] = None,
+    namespace: str = "",
+) -> CoreWorker:
+    """Attach a driver CoreWorker to an already-running local node."""
+    job_id = job_id or JobID.from_int(int.from_bytes(os.urandom(3), "little"))
+    worker = CoreWorker(
+        mode="driver",
+        node_id=node_id,
+        raylet_address=tuple(raylet_address),
+        gcs_address=tuple(gcs_address),
+        arena_path=arena_path,
+        job_id=job_id,
+        session_dir=session_dir,
+    )
+    worker.start()
+    worker.gcs.add_job(
+        job_info={
+            "job_id": job_id.hex(),
+            "driver_pid": os.getpid(),
+            "namespace": namespace,
+            "driver_address": list(worker.address),
+        }
+    )
+    return worker
+
+
+class Node:
+    """One logical ray_tpu node on this host (head or worker)."""
+
+    def __init__(
+        self,
+        *,
+        head: bool = True,
+        gcs_address: Optional[Tuple[str, int]] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        session_dir: Optional[str] = None,
+    ):
+        cfg = get_config()
+        self.session_dir = session_dir or os.path.join(
+            cfg.session_dir_root, f"session_{int(time.time())}_{os.getpid()}"
+        )
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self._procs = []
+        if head:
+            self.gcs_proc, self.gcs_address = start_gcs_server(self.session_dir)
+            self._procs.append(self.gcs_proc)
+        else:
+            assert gcs_address is not None
+            self.gcs_proc = None
+            self.gcs_address = gcs_address
+        self.raylet_proc, info = start_raylet(
+            self.session_dir,
+            self.gcs_address,
+            resources=resources,
+            labels=labels,
+            is_head=head,
+        )
+        self._procs.append(self.raylet_proc)
+        self.node_id = info["node_id"]
+        self.raylet_address = tuple(info["address"])
+        self.arena_path = info["arena_path"]
+        self.is_head = head
+        atexit.register(self.shutdown)
+
+    def connect_driver(self, job_id: Optional[JobID] = None,
+                       namespace: str = "") -> CoreWorker:
+        return connect_driver(
+            node_id=self.node_id,
+            raylet_address=self.raylet_address,
+            gcs_address=self.gcs_address,
+            arena_path=self.arena_path,
+            session_dir=self.session_dir,
+            job_id=job_id,
+            namespace=namespace,
+        )
+
+    def kill_raylet(self):
+        self.raylet_proc.kill()
+
+    def shutdown(self):
+        atexit.unregister(self.shutdown)
+        for proc in self._procs:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        deadline = time.time() + 3.0
+        for proc in self._procs:
+            try:
+                proc.wait(max(0.1, deadline - time.time()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass

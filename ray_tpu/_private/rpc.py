@@ -1,0 +1,392 @@
+"""Asyncio RPC layer: the control-plane transport for every process pair.
+
+The reference runs all control traffic over gRPC (src/ray/rpc/grpc_server.h,
+grpc_client.h) with a retrying client (retryable_grpc_client.cc) and
+deterministic failure injection (rpc_chaos.cc:33, env RAY_testing_rpc_failure).
+We keep the same shape — server with named handler methods, clients with
+retries and chaos injection — but implement it as a compact asyncio protocol
+(8-byte length-prefixed pickle frames) rather than gRPC: no codegen, lower
+per-call latency from Python than grpc's C extension, and the data plane never
+touches it (large objects ride shared memory / chunked push, see raylet.py).
+
+Every process runs one background "io thread" hosting a single asyncio event
+loop (EventLoopThread); all servers and clients in the process share it.
+Synchronous callers use ``call_sync`` which bridges via
+run_coroutine_threadsafe.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import random
+import socket
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+from .config import get_config
+
+_LEN = struct.Struct("<Q")
+_MAX_FRAME = 1 << 34  # 16 GiB sanity bound
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    pass
+
+
+class RpcApplicationError(RpcError):
+    """Handler raised; message carries the remote traceback string."""
+
+
+class ChaosInjectedError(RpcConnectionError):
+    """Raised by the failure injector (testing only)."""
+
+
+# ---------------------------------------------------------------------------
+# Failure injection (reference: src/ray/rpc/rpc_chaos.cc:33)
+# ---------------------------------------------------------------------------
+class _Chaos:
+    def __init__(self):
+        self._probs: Dict[str, float] = {}
+        spec = get_config().testing_rpc_failure or os.environ.get(
+            "RAY_TPU_TESTING_RPC_FAILURE", ""
+        )
+        for part in filter(None, spec.split(",")):
+            method, prob = part.rsplit(":", 1)
+            self._probs[method] = float(prob)
+        self._rng = random.Random(12345)
+
+    def should_fail(self, method: str) -> bool:
+        p = self._probs.get(method)
+        if p is None:
+            return False
+        return self._rng.random() < p
+
+
+_chaos: Optional[_Chaos] = None
+
+
+def _get_chaos() -> _Chaos:
+    global _chaos
+    if _chaos is None:
+        _chaos = _Chaos()
+    return _chaos
+
+
+def reset_chaos():
+    global _chaos
+    _chaos = None
+
+
+# ---------------------------------------------------------------------------
+# Event loop thread
+# ---------------------------------------------------------------------------
+class EventLoopThread:
+    """One asyncio loop on a daemon thread, shared process-wide."""
+
+    _instance: Optional["EventLoopThread"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name="ray_tpu-io", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._lock:
+            if cls._instance is None or not cls._instance._thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    def run(self, coro: Awaitable, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro: Awaitable):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+def get_loop() -> asyncio.AbstractEventLoop:
+    return EventLoopThread.get().loop
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    hdr = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > _MAX_FRAME:
+        raise RpcConnectionError(f"frame too large: {n}")
+    data = await reader.readexactly(n)
+    return pickle.loads(data)
+
+
+def _write_frame(writer: asyncio.StreamWriter, msg: Any):
+    data = pickle.dumps(msg, protocol=5)
+    writer.write(_LEN.pack(len(data)))
+    writer.write(data)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+Handler = Callable[..., Awaitable[Any]]
+
+
+class RpcServer:
+    """Serves named async handlers. Handler signature: async def h(**kwargs).
+
+    Register with ``server.register(obj)`` (exposes every public async method)
+    or ``server.register_method(name, fn)``.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers: Dict[str, Handler] = {}
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+    # lifecycle methods must never be remotely callable
+    _EXCLUDED = frozenset({"start", "stop", "close", "shutdown"})
+
+    def register_method(self, name: str, fn: Handler):
+        self._handlers[name] = fn
+
+    def register(self, obj: Any, prefix: str = ""):
+        for name in dir(obj):
+            if name.startswith("_") or name in self._EXCLUDED:
+                continue
+            fn = getattr(obj, name)
+            if asyncio.iscoroutinefunction(fn):
+                self._handlers[prefix + name] = fn
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_connection, self._host, self._port,
+        )
+        sock = self._server.sockets[0]
+        self.address = sock.getsockname()[:2]
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    seq, method, kwargs = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                asyncio.ensure_future(
+                    self._dispatch(writer, seq, method, kwargs)
+                )
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, seq, method, kwargs):
+        try:
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcApplicationError(f"no such method: {method}")
+            result = await handler(**kwargs)
+            reply = (seq, 0, result)
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            import traceback
+
+            reply = (seq, 1, f"{type(e).__name__}: {e}\n{traceback.format_exc()}")
+        try:
+            _write_frame(writer, reply)
+            await writer.drain()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+class RpcClient:
+    """Persistent connection to one server, with retries + chaos injection.
+
+    Mirrors the reference's RetryableGrpcClient: transient connection errors
+    are retried with backoff up to config.rpc_max_retries; application errors
+    (handler raised) are NOT retried here — the caller decides.
+    """
+
+    def __init__(self, host: str, port: int, *, retries: Optional[int] = None):
+        self.host = host
+        self.port = port
+        cfg = get_config()
+        self._retries = cfg.rpc_max_retries if retries is None else retries
+        self._retry_delay = cfg.rpc_retry_delay_s
+        self._connect_timeout = cfg.rpc_connect_timeout_s
+        self._seq = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader_task: Optional[asyncio.Task] = None
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    async def _ensure_connected(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port),
+                self._connect_timeout,
+            )
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._writer = writer
+            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader):
+        try:
+            while True:
+                seq, status, payload = await _read_frame(reader)
+                fut = self._pending.pop(seq, None)
+                if fut is None or fut.done():
+                    continue
+                if status == 0:
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(RpcApplicationError(payload))
+        except Exception as e:
+            err = RpcConnectionError(f"connection to {self.host}:{self.port} lost: {e}")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            self._writer = None
+
+    async def call(self, method: str, timeout: Optional[float] = None, **kwargs):
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries + 1):
+            if self._closed:
+                raise RpcConnectionError("client closed")
+            try:
+                if _get_chaos().should_fail(method):
+                    raise ChaosInjectedError(f"chaos: {method}")
+                await self._ensure_connected()
+            except Exception as e:  # connect failure/timeout: retry
+                last_err = e
+                self._writer = None
+                if attempt < self._retries:
+                    await asyncio.sleep(self._retry_delay * (2**attempt))
+                continue
+            self._seq += 1
+            seq = self._seq
+            fut = asyncio.get_running_loop().create_future()
+            self._pending[seq] = fut
+            try:
+                _write_frame(self._writer, (seq, method, kwargs))
+                await self._writer.drain()
+                if timeout is not None:
+                    return await asyncio.wait_for(fut, timeout)
+                return await fut
+            except RpcApplicationError:
+                raise
+            except asyncio.TimeoutError:
+                self._pending.pop(seq, None)
+                raise
+            except Exception as e:  # connection dropped mid-call: retry
+                last_err = e
+                self._pending.pop(seq, None)
+                self._writer = None
+                if attempt < self._retries:
+                    await asyncio.sleep(self._retry_delay * (2**attempt))
+        raise RpcConnectionError(
+            f"rpc {method} to {self.host}:{self.port} failed after "
+            f"{self._retries + 1} attempts: {last_err}"
+        )
+
+    def call_sync(self, method: str, timeout: Optional[float] = None, **kwargs):
+        return EventLoopThread.get().run(
+            self.call(method, timeout=timeout, **kwargs),
+            None if timeout is None else timeout + 5.0,
+        )
+
+    async def close(self):
+        self._closed = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            self._reader_task = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+
+    def close_sync(self):
+        try:
+            EventLoopThread.get().run(self.close(), 5.0)
+        except Exception:
+            pass
+
+
+class ClientPool:
+    """Address-keyed client cache (reference: core_worker_client_pool.h)."""
+
+    def __init__(self):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, host: str, port: int) -> RpcClient:
+        key = (host, port)
+        with self._lock:
+            cli = self._clients.get(key)
+            if cli is None or cli._closed:
+                cli = RpcClient(host, port)
+                self._clients[key] = cli
+            return cli
+
+    def remove(self, host: str, port: int):
+        with self._lock:
+            cli = self._clients.pop((host, port), None)
+        if cli is not None:
+            cli.close_sync()
+
+    def close_all(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close_sync()
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

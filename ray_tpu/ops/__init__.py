@@ -1,0 +1,2 @@
+"""TPU kernels (Pallas) + portable fallbacks for the hot ops."""
+from .attention import attention_block, flash_attention  # noqa: F401

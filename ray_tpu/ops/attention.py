@@ -1,0 +1,220 @@
+"""Attention ops: Pallas flash attention (TPU) + fused-jnp fallback.
+
+The only place this framework writes novel kernels rather than
+orchestration (SURVEY §7 hard parts). The reference has no attention code
+at all — it delegates to torch/vLLM — so these kernels are designed from
+the TPU architecture: q/k/v blocks tiled to the MXU (128-lane), f32
+accumulation in VMEM scratch, online softmax across the kv-block grid
+dimension (grid iterates sequentially on TPU, enabling cross-iteration
+scratch accumulation).
+
+Exports:
+  attention_block(q, k, v, mask, scale) -> (o, m, l) blockwise partials —
+      the unit of work one ring-attention step consumes (parallel/ring_attention.py).
+  flash_attention(q, k, v, causal, scale) -> o — full attention for
+      single-shard paths (models/), Pallas on TPU, jnp elsewhere.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: expand kv heads to match query heads. [B,T,Hkv,D] -> [B,T,H,D]"""
+    if n_rep == 1:
+        return k
+    B, T, Hkv, D = k.shape
+    return jnp.broadcast_to(
+        k[:, :, :, None, :], (B, T, Hkv, n_rep, D)
+    ).reshape(B, T, Hkv * n_rep, D)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise partials (jnp; consumed by ring attention)
+# ---------------------------------------------------------------------------
+def attention_block(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,  # [B, T, Hkv, D]
+    mask: Optional[jax.Array] = None,  # [S, T] True = attend
+    scale: Optional[float] = None,
+):
+    """Returns (o, m, l): normalized block output, row max, row sum (f32).
+
+    Rows fully masked out yield o=0, m=-inf, l=0 so the flash combine in
+    ring_attention treats them as empty.
+    """
+    B, S, H, D = q.shape
+    scale = (D ** -0.5) if scale is None else scale
+    k = _repeat_kv(k, H // k.shape[2])
+    v = _repeat_kv(v, H // v.shape[2])
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [B,H,S]
+    m_masked = jnp.where(m <= _NEG_INF / 2, -jnp.inf, m)
+    p = jnp.exp(scores - jnp.where(jnp.isfinite(m_masked), m, 0.0)[..., None])
+    p = jnp.where(jnp.isfinite(m_masked)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,S]
+    o = jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    o = o / denom.transpose(0, 2, 1)[..., None]
+    return (
+        o,  # [B,S,H,D] f32
+        m_masked.transpose(0, 2, 1),  # [B,S,H]
+        l.transpose(0, 2, 1),  # [B,S,H]
+    )
+
+
+# ---------------------------------------------------------------------------
+# Full attention — jnp reference path
+# ---------------------------------------------------------------------------
+def _attention_jnp(q, k, v, causal: bool, scale: float) -> jax.Array:
+    B, S, H, D = q.shape
+    T = k.shape[1]
+    k = _repeat_kv(k, H // k.shape[2])
+    v = _repeat_kv(v, H // v.shape[2])
+    scores = jnp.einsum(
+        "bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        # allows T >= S (KV-cache decode: queries are the last S positions)
+        q_pos = jnp.arange(S) + (T - S)
+        mask = q_pos[:, None] >= jnp.arange(T)[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", p, v.astype(jnp.float32)).astype(
+        q.dtype
+    )
+
+
+# ---------------------------------------------------------------------------
+# Full attention — Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, causal: bool, scale: float, block_q: int, block_k: int,
+                  seq_k: int):
+    """Grid: (B, H, nq, nk) — nk innermost; scratch persists across nk."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _compute():
+        q = q_ref[0, :, :].astype(jnp.float32)  # [bq, D]
+        k = k_ref[0, :, :].astype(jnp.float32)  # [bk, D]
+        v = v_ref[0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [bq, bk]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_cur
+        l_scr[:, 0] = l_cur
+
+    if causal:
+        # skip k-blocks strictly after the last query row of this q-block
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, :] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
+                            block_q: int = 128, block_k: int = 128):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    T = k.shape[1]
+    k = _repeat_kv(k, H // k.shape[2])
+    v = _repeat_kv(v, H // v.shape[2])
+    # [B,S,H,D] -> [B*H, S, D] layout: head-major grid
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(T, block_k)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k,
+        ),
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(qt, kt, vt)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, Hkv, D]
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    D = q.shape[-1]
+    scale = (D ** -0.5) if scale is None else scale
+    # Gate statically on the lowering backend (safe under jit tracing).
+    if jax.default_backend() == "tpu" and q.shape[1] >= 128 and q.shape[1] == k.shape[1]:
+        try:
+            return _flash_attention_pallas(q, k, v, causal, scale)
+        except Exception:
+            pass  # fall through to the portable path
+    return _attention_jnp(q, k, v, causal, scale)

@@ -1,0 +1,24 @@
+"""Parallelism layer: device meshes, sharding rules, collectives, and
+sequence-parallel attention (ring + Ulysses).
+
+This is the TPU-native replacement for the reference's accelerator data
+plane (reference: python/ray/util/collective/, experimental/channel/
+nccl_group.py, torch DDP/FSDP delegation in train/) — collectives are XLA
+programs over a jax.sharding.Mesh riding ICI, not NCCL calls.
+"""
+from .mesh import MeshSpec, create_mesh, local_mesh  # noqa: F401
+from .sharding import (  # noqa: F401
+    LOGICAL_RULES,
+    logical_sharding,
+    shard_params,
+    with_sharding_constraint,
+)
+from .collectives import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    reducescatter,
+)
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,157 @@
+"""Collectives: device-plane (XLA/ICI) + host-plane (control sync).
+
+Reference: python/ray/util/collective/collective.py — GroupManager (:60),
+init_collective_group (:150), allreduce (:295) over NCCL/Gloo backends.
+
+TPU-native split (SURVEY §2.3):
+  * Device plane — collectives are jax.lax ops compiled into the step
+    program; XLA schedules them on ICI. The functions here are thin names
+    over lax primitives so library code reads like the reference API while
+    remaining shard_map/pjit-compatible.
+  * Host plane — the Gloo-equivalent: small CPU values synchronized between
+    actors through the GCS KV store (barrier/broadcast/allreduce). Used by
+    the Train worker group for rendezvous before the mesh exists (the
+    reference's TCPStore + init_process_group moment, train/torch/config.py).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Device plane (usable inside shard_map/pjit programs)
+# ---------------------------------------------------------------------------
+
+
+def allreduce(x, axis_name: str, op: str = "sum"):
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reducescatter(x, axis_name: str, axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                tiled=True)
+
+
+def broadcast(x, axis_name: str, root: int = 0):
+    idx = jax.lax.axis_index(axis_name)
+    size = jax.lax.axis_size(axis_name)
+    # one-hot select of the root's shard, summed over the axis
+    mask = (idx == root).astype(x.dtype)
+    return jax.lax.psum(x * mask, axis_name)
+
+
+def permute(x, axis_name: str, shift: int = 1):
+    """Ring permute: send shard to (rank+shift) mod n."""
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        tiled=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host plane (GCS-KV backed; Gloo equivalent for control sync)
+# ---------------------------------------------------------------------------
+class HostCollectiveGroup:
+    """Rendezvous + tiny-value collectives between processes via GCS KV.
+
+    Reference shape: collective_group/gloo_collective_group.py — but there
+    is no sidecar store process; the GCS KV (gcs_kv_manager.h equivalent)
+    is the rendezvous point.
+    """
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 gcs_client=None):
+        if gcs_client is None:
+            from .._private.core_worker import global_worker
+
+            gcs_client = global_worker().gcs
+        self.gcs = gcs_client
+        self.group = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self._seq = 0
+        self._ns = f"collective:{group_name}"
+
+    def _next_key(self, op: str) -> str:
+        self._seq += 1
+        return f"{op}:{self._seq}"
+
+    def _put(self, key: str, payload: bytes):
+        self.gcs.kv_put(ns=self._ns, key=f"{key}:{self.rank}", value=payload)
+
+    def _wait_all(self, key: str, timeout: float) -> dict:
+        deadline = time.monotonic() + timeout
+        while True:
+            keys = self.gcs.kv_keys(ns=self._ns, prefix=f"{key}:")
+            if len(keys) >= self.world_size:
+                return self.gcs.kv_multi_get(ns=self._ns, keys=keys)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {key} in group {self.group}: "
+                    f"{len(keys)}/{self.world_size} arrived"
+                )
+            time.sleep(0.01)
+
+    def barrier(self, timeout: float = 120.0):
+        key = self._next_key("barrier")
+        self._put(key, b"1")
+        self._wait_all(key, timeout)
+
+    def broadcast_obj(self, value: Any = None, root: int = 0,
+                      timeout: float = 120.0) -> Any:
+        import pickle
+
+        key = self._next_key("bcast")
+        if self.rank == root:
+            self.gcs.kv_put(ns=self._ns, key=f"{key}:root",
+                            value=pickle.dumps(value))
+            return value
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = self.gcs.kv_get(ns=self._ns, key=f"{key}:root")
+            if raw is not None:
+                return pickle.loads(raw)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"broadcast {key} timed out")
+            time.sleep(0.01)
+
+    def allgather_obj(self, value: Any, timeout: float = 120.0) -> list:
+        import pickle
+
+        key = self._next_key("gather")
+        self._put(key, pickle.dumps(value))
+        got = self._wait_all(key, timeout)
+        out = [None] * self.world_size
+        for k, v in got.items():
+            out[int(k.rsplit(":", 1)[1])] = pickle.loads(v)
+        return out
+
+    def allreduce_obj(self, value, reduce_fn: Callable = sum,
+                      timeout: float = 120.0):
+        return reduce_fn(self.allgather_obj(value, timeout))
+
+
+def barrier(group: HostCollectiveGroup):
+    group.barrier()

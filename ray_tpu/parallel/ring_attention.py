@@ -1,0 +1,93 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Not present in the reference (SURVEY §5.7: Ray has no context parallelism;
+it delegates long-context to wrapped engines). Here it is a first-class op:
+the `seq` mesh axis shards the sequence; K/V shards rotate around the ring
+via `ppermute` (ICI neighbor exchange) while each device accumulates its
+queries' attention with a numerically-stable blockwise softmax
+(Liu et al., Ring Attention; see PAPERS.md).
+
+Usage inside shard_map (see ulysses.py for the alternative a2a scheme):
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq", causal=True),
+        mesh=mesh,
+        in_specs=P(("data","fsdp"), "seq", None, None), ...)
+
+Per-step local block math runs through ops.attention_block, which lowers to
+a Pallas flash kernel on TPU and a fused-jnp path elsewhere.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention_block
+
+
+def _combine(o, m, l, o_i, m_i, l_i):
+    """Merge two blockwise-softmax partials (flash-attention combine)."""
+    m_new = jnp.maximum(m, m_i)
+    a = jnp.exp(m - m_new) * l
+    b = jnp.exp(m_i - m_new) * l_i
+    l_new = a + b
+    denom = jnp.where(l_new == 0.0, 1.0, l_new)
+    o_new = (o * a[..., None] + o_i * b[..., None]) / denom[..., None]
+    return o_new, m_new, l_new
+
+
+@partial(jax.named_call, name="ring_attention")
+def ring_attention(
+    q: jax.Array,  # [B, S_local, H, D]
+    k: jax.Array,  # [B, S_local, Hkv, D]
+    v: jax.Array,  # [B, S_local, Hkv, D]
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Must be called inside shard_map/pjit with ``axis_name`` bound. K/V
+    travel the ring; O(S_local^2 * n) compute per device, O(S_local) memory.
+    """
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = (D ** -0.5) if scale is None else scale
+
+    # Build the initial accumulators FROM q so they carry q's device-varying
+    # axes (jax>=0.9 tracks manual-axis variance through scan carries; a
+    # plain zeros() would be "unvarying" and fail the carry type check).
+    qf = q.astype(jnp.float32)
+    o = qf * 0.0
+    m = qf[..., 0] * 0.0 - jnp.inf
+    l = qf[..., 0] * 0.0
+
+    q_pos = rank * S + jnp.arange(S)  # global positions of local queries
+
+    def step(carry, step_idx):
+        o, m, l, k_cur, v_cur = carry
+        src = (rank - step_idx) % n  # which shard k_cur/v_cur came from
+        kv_pos = src * S + jnp.arange(S)
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]  # [S, S]
+        else:
+            mask = None
+        o_i, m_i, l_i = attention_block(
+            q, k_cur, v_cur, mask=mask, scale=scale
+        )
+        o, m, l = _combine(o, m, l, o_i, m_i, l_i)
+        # rotate K/V to the next rank (overlaps with next step's compute
+        # under XLA's latency-hiding scheduler on TPU)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v), jnp.arange(n)
+    )
+    return o.astype(q.dtype)

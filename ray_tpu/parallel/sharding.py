@@ -1,0 +1,85 @@
+"""Logical-axis sharding rules: how model tensors map onto the mesh.
+
+The reference delegates sharding to torch FSDP/DeepSpeed
+(train/lightning/_lightning_utils.py:57-153) and vLLM's Megatron layout
+(llm/.../vllm_models.py:206). Here sharding is declarative: tensors carry
+*logical* axis names and a single rules table maps logical axes to mesh
+axes — change the table, change the parallelism, no model edits (GSPMD
+fills in the collectives).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate)
+LOGICAL_RULES: Dict[str, Optional[object]] = {
+    # activations
+    "batch": ("data", "fsdp"),
+    "act_seq": "seq",
+    "act_embed": None,
+    "act_heads": "tensor",
+    "act_kv": None,
+    # params
+    "embed": "fsdp",          # ZeRO: shard the embed dim of every weight
+    "mlp": "tensor",          # Megatron column/row split
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "qkv_dim": None,
+    "vocab": "tensor",
+    "expert": "expert",
+    "norm": None,
+}
+
+
+def resolve_spec(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, object]] = None,
+) -> PartitionSpec:
+    rules = LOGICAL_RULES if rules is None else rules
+    out = []
+    used = set()
+    for ax in logical_axes:
+        mesh_ax = rules.get(ax) if ax is not None else None
+        # a mesh axis may appear only once in a spec; later dims replicate
+        if mesh_ax is None:
+            out.append(None)
+        elif isinstance(mesh_ax, tuple):
+            picked = tuple(a for a in mesh_ax if a not in used)
+            used.update(picked)
+            out.append(picked if picked else None)
+        else:
+            if mesh_ax in used:
+                out.append(None)
+            else:
+                used.add(mesh_ax)
+                out.append(mesh_ax)
+    return PartitionSpec(*out)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, object]] = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, resolve_spec(logical_axes, rules))
+
+
+def shard_params(mesh: Mesh, params, axes_tree, rules=None):
+    """Device-put a parameter pytree according to its logical-axes pytree."""
+
+    def place(p, axes):
+        return jax.device_put(p, logical_sharding(mesh, axes, rules))
+
+    return jax.tree_util.tree_map(
+        place, params, axes_tree, is_leaf=lambda x: x is None
+    )
+
+
+def with_sharding_constraint(x, mesh: Mesh, logical_axes, rules=None):
+    """Annotate an activation inside jit (GSPMD propagates the rest)."""
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules)
+    )

@@ -1,0 +1,55 @@
+"""Ulysses sequence parallelism: all-to-all head<->sequence resharding.
+
+Not present in the reference (SURVEY §5.7). DeepSpeed-Ulysses scheme:
+activations arrive sharded on sequence; two all-to-alls swap the sharding
+to heads for the (full-sequence) attention, then back. Cheaper than ring
+attention when heads % seq_parallelism == 0 and sequence fits per-device
+HBM after the swap; ring attention covers the longer-context regime.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S_local, H, D] — sequence-sharded on axis_name
+    k: jax.Array,  # [B, S_local, Hkv, D]
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Call inside shard_map with sequence sharded over ``axis_name``.
+    Returns output sharded on sequence again. Requires H % n == 0 and
+    Hkv % n == 0 (or Hkv == 1)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] --a2a--> [B, S, H/n, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    H, Hkv = q.shape[2], k.shape[2]
+    if Hkv < n and Hkv != H:
+        # GQA with fewer kv heads than ranks: replicate kv heads up to n
+        rep = n // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qh = seq_to_heads(q)
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)

@@ -1,0 +1,100 @@
+"""@ray_tpu.remote for functions.
+
+Reference: python/ray/remote_function.py — RemoteFunction holds the
+serialized function (pickled once, reused across calls) and submission
+options; ``.remote()`` routes to CoreWorker.submit_task (reference
+remote_function.py:314 → :490); ``.options()`` returns a shallow override.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ._private.core_worker import global_worker
+
+
+def _demand_from_options(o: Dict[str, Any]) -> Dict[str, float]:
+    demand: Dict[str, float] = {}
+    num_cpus = o.get("num_cpus")
+    demand["CPU"] = float(1 if num_cpus is None else num_cpus)
+    if o.get("num_tpus"):
+        demand["TPU"] = float(o["num_tpus"])
+    if o.get("num_gpus"):
+        demand["GPU"] = float(o["num_gpus"])
+    if o.get("memory"):
+        demand["memory"] = float(o["memory"])
+    for k, v in (o.get("resources") or {}).items():
+        demand[k] = float(v)
+    return demand
+
+
+def _strategy_from_options(o: Dict[str, Any]):
+    strat = o.get("scheduling_strategy")
+    if strat is None:
+        return "DEFAULT", {}
+    if isinstance(strat, str):
+        return strat, {}
+    # strategy objects (util/scheduling_strategies.py)
+    from .util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+        NodeLabelSchedulingStrategy,
+    )
+
+    if isinstance(strat, PlacementGroupSchedulingStrategy):
+        return "DEFAULT", {
+            "placement_group_id": strat.placement_group.id_hex,
+            "bundle_index": strat.placement_group_bundle_index,
+        }
+    if isinstance(strat, NodeAffinitySchedulingStrategy):
+        return "NodeAffinity", {
+            "node_id": strat.node_id,
+            "soft": strat.soft,
+        }
+    if isinstance(strat, NodeLabelSchedulingStrategy):
+        return "DEFAULT", {"label_selector": strat.hard}
+    raise TypeError(f"unknown scheduling strategy {strat!r}")
+
+
+class RemoteFunction:
+    def __init__(self, func, **options):
+        self._function = func
+        self._options = options
+        self._pickled: Optional[bytes] = None
+        self.__name__ = getattr(func, "__name__", "remote_function")
+        self.__doc__ = getattr(func, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self.__name__}() cannot be called directly; "
+            f"use {self.__name__}.remote()"
+        )
+
+    def options(self, **overrides) -> "RemoteFunction":
+        rf = RemoteFunction(self._function, **{**self._options, **overrides})
+        rf._pickled = self._pickled  # function bytes unchanged
+        return rf
+
+    def remote(self, *args, **kwargs):
+        worker = global_worker()
+        if self._pickled is None:
+            self._pickled = cloudpickle.dumps(self._function)
+        o = self._options
+        strategy, params = _strategy_from_options(o)
+        num_returns = o.get("num_returns", 1)
+        refs = worker.submit_task(
+            self._function,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            demand=_demand_from_options(o),
+            max_retries=o.get("max_retries"),
+            strategy=strategy,
+            strategy_params=params,
+            name=o.get("name", self.__name__),
+            serialized_func=self._pickled,
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs

@@ -1,0 +1,30 @@
+"""ray_tpu.train — distributed training orchestration (Ray Train v2 shape).
+
+Reference: python/ray/train/v2/ — DataParallelTrainer
+(v2/api/data_parallel_trainer.py:108) driving a TrainController
+(controller/controller.py:94) over a WorkerGroup (worker_group.py:99) of
+per-rank actors on a placement group, with report(metrics, checkpoint),
+StorageContext persistence (train/_internal/storage.py:358) and a
+FailurePolicy (failure_handling/failure_policy.py:14).
+
+TPU-native differences (SURVEY §7):
+  - a worker == one TPU *host* (the scheduling atom), not one chip; the
+    worker group is gang-scheduled via a placement group whose bundles
+    carry TPU resources and slice labels (ICI-aware packing).
+  - the collective plane inside the slice is jax/XLA (the worker calls
+    setup_jax_distributed, the jax.distributed.initialize analogue of
+    _TorchBackend.on_start's init_process_group, train/torch/config.py:153).
+  - failures restart the whole gang from the last checkpoint (a pjit
+    program needs every host of the slice; no per-worker elasticity).
+"""
+from .api import (  # noqa: F401
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+    TrainContext,
+    get_context,
+    report,
+)
+from .checkpoint import Checkpoint, StorageContext  # noqa: F401

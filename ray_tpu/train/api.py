@@ -1,0 +1,358 @@
+"""Trainer / controller / worker-group implementation.
+
+Reference call stack (SURVEY §3.5): TorchTrainer.fit →
+TrainController.run (v2/_internal/execution/controller/controller.py:462) →
+WorkerGroup (worker_group.py:99) of per-rank actors on a PG →
+backend.on_start (torch/config.py:153) → user train_func per worker →
+report(metrics, checkpoint) → StorageContext persist → FailurePolicy
+(failure_policy.py:14) on worker death.
+
+Here the controller is a driver-side loop (fit() blocks anyway), workers
+are gang-scheduled actors polled for reports, and the collective plane is
+jax: setup_jax_distributed() inside the train_func wires
+jax.distributed.initialize from the rendezvous the worker group prepares.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from .checkpoint import Checkpoint, StorageContext
+
+
+@dataclass
+class ScalingConfig:
+    """Reference: ray.train.ScalingConfig (air/config.py)."""
+
+    num_workers: int = 1
+    resources_per_worker: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1}
+    )
+    use_tpu: bool = False
+    tpu_chips_per_worker: int = 4  # one TPU VM host = 4 chips typical
+    placement_strategy: str = "PACK"  # one ICI domain when possible
+
+    def worker_demand(self) -> Dict[str, float]:
+        demand = dict(self.resources_per_worker)
+        if self.use_tpu:
+            demand.setdefault("TPU", float(self.tpu_chips_per_worker))
+        return demand
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # gang restarts allowed
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: str = "/tmp/ray_tpu/train_runs"
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_keep_last: int = 3
+
+
+@dataclass
+class Result:
+    """Reference: ray.train.Result."""
+
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[str] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# worker-side context (reference: ray.train.get_context() + report())
+# ---------------------------------------------------------------------------
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, run_name: str,
+                 rendezvous: Dict[str, Any], config: Dict[str, Any],
+                 checkpoint: Optional[Checkpoint]):
+        self.rank = rank
+        self.world_size = world_size
+        self.run_name = run_name
+        self.rendezvous = rendezvous
+        self.config = config
+        self._checkpoint = checkpoint
+        self._reports: List[dict] = []
+        self._lock = threading.Lock()
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._checkpoint
+
+    def setup_jax_distributed(self):
+        """jax.distributed.initialize over the group rendezvous — the
+        _TorchBackend.on_start analogue (train/torch/config.py:153). No-op
+        for world_size == 1 (single host owns all local chips)."""
+        if self.world_size <= 1:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.rendezvous["coordinator"],
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )
+
+
+_context: Optional[TrainContext] = None
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError("not inside a ray_tpu.train worker")
+    return _context
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None):
+    """Reference: ray.train.report — metrics every rank; checkpoint
+    typically from rank 0."""
+    ctx = get_context()
+    with ctx._lock:
+        ctx._reports.append(
+            {
+                "metrics": dict(metrics),
+                "checkpoint_path": checkpoint.path if checkpoint else None,
+                "time": time.time(),
+                "rank": ctx.rank,
+            }
+        )
+
+
+# ---------------------------------------------------------------------------
+# worker actor
+# ---------------------------------------------------------------------------
+class _TrainWorker:
+    """One per rank; created by the controller on the gang PG."""
+
+    def __init__(self, rank: int, world_size: int, run_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.run_name = run_name
+        self._thread: Optional[threading.Thread] = None
+        self._done = False
+        self._error: Optional[str] = None
+
+    def hostname(self) -> str:
+        return socket.gethostname()
+
+    def free_port(self) -> int:
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def run(self, train_func_payload: bytes, config: Dict[str, Any],
+            rendezvous: Dict[str, Any],
+            checkpoint: Optional[Checkpoint]) -> bool:
+        """Start the user function on a thread; controller polls status."""
+        import cloudpickle
+
+        train_func = cloudpickle.loads(train_func_payload)
+        global _context
+        _context = TrainContext(
+            self.rank, self.world_size, self.run_name, rendezvous,
+            config, checkpoint,
+        )
+        self._ctx = _context
+
+        def target():
+            try:
+                ctx = self._ctx
+                try:
+                    train_func(config)
+                except TypeError as e:
+                    if "positional argument" in str(e):
+                        train_func()
+                    else:
+                        raise
+            except Exception:
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=target, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        """Drain new reports + status."""
+        with self._ctx._lock:
+            reports, self._ctx._reports = self._ctx._reports, []
+        return {"done": self._done, "error": self._error,
+                "reports": reports}
+
+
+# ---------------------------------------------------------------------------
+# trainer (controller loop lives in fit())
+# ---------------------------------------------------------------------------
+class JaxTrainer:
+    """Reference: DataParallelTrainer (v2/api/data_parallel_trainer.py:108).
+
+    train_func runs on every worker; workers form one gang. On any worker
+    failure, the whole group restarts from the latest checkpoint
+    (slice-granularity elasticity — SURVEY §7)."""
+
+    def __init__(
+        self,
+        train_func: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.train_func = train_func
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> Result:
+        import cloudpickle
+
+        import ray_tpu as ray
+
+        storage = StorageContext(
+            self.run_config.storage_path,
+            self.run_config.name,
+            keep_last=self.run_config.checkpoint_keep_last,
+        )
+        payload = cloudpickle.dumps(self.train_func)
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        history: List[dict] = []
+        ckpt_index = 0
+
+        while True:
+            try:
+                metrics, ckpt_index = self._run_attempt(
+                    ray, payload, storage, history, ckpt_index
+                )
+                return Result(
+                    metrics=metrics,
+                    checkpoint=storage.latest_checkpoint(),
+                    path=storage.run_dir,
+                    metrics_history=history,
+                )
+            except _AttemptFailed as e:
+                attempt += 1
+                if attempt > max_failures:
+                    return Result(
+                        metrics=history[-1]["metrics"] if history else {},
+                        checkpoint=storage.latest_checkpoint(),
+                        path=storage.run_dir,
+                        error=str(e),
+                        metrics_history=history,
+                    )
+                # gang restart from latest checkpoint
+
+    def _run_attempt(self, ray, payload, storage, history, ckpt_index):
+        sc = self.scaling
+        n = sc.num_workers
+        demand = sc.worker_demand()
+
+        pg = None
+        strategy_opts: Dict[str, Any] = {}
+        if n > 1:
+            pg = ray.placement_group(
+                [demand] * n, strategy=sc.placement_strategy
+            )
+            if not pg.ready(timeout=120):
+                raise _AttemptFailed("placement group not ready")
+
+        WorkerCls = ray.remote(_TrainWorker)
+        workers = []
+        for rank in range(n):
+            options: Dict[str, Any] = {}
+            for key, val in demand.items():
+                if key == "CPU":
+                    options["num_cpus"] = val
+                elif key == "TPU":
+                    options["num_tpus"] = val
+                else:
+                    options.setdefault("resources", {})[key] = val
+            if pg is not None:
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy,
+                )
+
+                options["scheduling_strategy"] = (
+                    PlacementGroupSchedulingStrategy(pg, rank)
+                )
+            workers.append(
+                WorkerCls.options(**options).remote(
+                    rank, n, storage.run_name
+                )
+            )
+
+        try:
+            # rendezvous: rank0's host + a free port for jax.distributed
+            host = ray.get(workers[0].hostname.remote(), timeout=120)
+            port = ray.get(workers[0].free_port.remote(), timeout=60)
+            rendezvous = {"coordinator": f"{host}:{port}"}
+
+            latest = storage.latest_checkpoint()
+            ray.get(
+                [
+                    w.run.remote(payload, self.config, rendezvous, latest)
+                    for w in workers
+                ],
+                timeout=300,
+            )
+
+            final_metrics: Dict[str, Any] = {}
+            done = [False] * n
+            while not all(done):
+                time.sleep(0.2)
+                polls = ray.get(
+                    [w.poll.remote() for w in workers], timeout=120
+                )
+                for rank, p in enumerate(polls):
+                    for rep in p["reports"]:
+                        history.append(rep)
+                        if rank == 0:
+                            final_metrics = rep["metrics"]
+                            if rep.get("checkpoint_path"):
+                                ckpt_index += 1
+                                storage.persist(
+                                    Checkpoint(rep["checkpoint_path"]),
+                                    ckpt_index,
+                                    rep["metrics"],
+                                )
+                    if p["error"]:
+                        raise _AttemptFailed(
+                            f"worker {rank} failed:\n{p['error']}"
+                        )
+                    done[rank] = p["done"]
+            return final_metrics, ckpt_index
+        except (ray.RayError, TimeoutError, ConnectionError) as e:
+            raise _AttemptFailed(f"worker group failure: {e}") from e
+        finally:
+            for w in workers:
+                try:
+                    ray.kill(w)
+                except Exception:
+                    pass
+            if pg is not None:
+                try:
+                    ray.remove_placement_group(pg)
+                except Exception:
+                    pass
+
+
+class _AttemptFailed(Exception):
+    pass

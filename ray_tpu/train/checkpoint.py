@@ -1,0 +1,137 @@
+"""Checkpoints: directory handles + storage persistence.
+
+Reference: train/_checkpoint.py:56 (Checkpoint = dir handle with
+to_directory/from_directory) and train/_internal/storage.py:358
+(StorageContext uploads via pyarrow fs). Array pytrees ride orbax when
+available (TPU-native serialization of sharded jax arrays), msgpack/np
+otherwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        if dest is None or os.path.abspath(dest) == self.path:
+            return self.path
+        os.makedirs(dest, exist_ok=True)
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # --- convenience for jax pytrees ----------------------------------
+    @classmethod
+    def from_state(cls, state: Any, path: str) -> "Checkpoint":
+        """Persist a jax/numpy pytree (orbax when importable)."""
+        os.makedirs(path, exist_ok=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(path, "state"), state, force=True)
+            ckptr.wait_until_finished()
+        except Exception:
+            import pickle
+
+            import jax
+            import numpy as np
+
+            host_state = jax.tree_util.tree_map(np.asarray, state)
+            with open(os.path.join(path, "state.pkl"), "wb") as f:
+                pickle.dump(host_state, f)
+        return cls(path)
+
+    def load_state(self, like: Any = None) -> Any:
+        orbax_path = os.path.join(self.path, "state")
+        if os.path.exists(orbax_path):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            if like is not None:
+                import jax
+
+                abstract = jax.tree_util.tree_map(
+                    ocp.utils.to_shape_dtype_struct
+                    if hasattr(ocp.utils, "to_shape_dtype_struct")
+                    else (lambda x: x),
+                    like,
+                )
+                try:
+                    return ckptr.restore(orbax_path, abstract)
+                except Exception:
+                    pass
+            return ckptr.restore(orbax_path)
+        import pickle
+
+        with open(os.path.join(self.path, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+class StorageContext:
+    """Run directory layout + checkpoint rotation.
+
+    storage_path/run_name/checkpoint_<step>/...   (latest tracked in
+    latest.json; mirrors the reference's StorageContext layout).
+    """
+
+    def __init__(self, storage_path: str, run_name: Optional[str] = None,
+                 keep_last: int = 3):
+        self.storage_path = storage_path
+        self.run_name = run_name or f"run_{int(time.time())}"
+        self.run_dir = os.path.join(storage_path, self.run_name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.keep_last = keep_last
+
+    def checkpoint_dir(self, index: int) -> str:
+        return os.path.join(self.run_dir, f"checkpoint_{index:06d}")
+
+    def persist(self, checkpoint: Checkpoint, index: int,
+                metrics: Optional[Dict] = None) -> Checkpoint:
+        dest = self.checkpoint_dir(index)
+        checkpoint.to_directory(dest)
+        with open(os.path.join(dest, "_metadata.json"), "w") as f:
+            json.dump({"index": index, "metrics": metrics or {},
+                       "time": time.time()}, f)
+        with open(os.path.join(self.run_dir, "latest.json"), "w") as f:
+            json.dump({"index": index, "path": dest}, f)
+        self._rotate()
+        return Checkpoint(dest)
+
+    def _rotate(self):
+        ckpts = sorted(
+            d for d in os.listdir(self.run_dir)
+            if d.startswith("checkpoint_")
+        )
+        for stale in ckpts[: -self.keep_last]:
+            shutil.rmtree(os.path.join(self.run_dir, stale),
+                          ignore_errors=True)
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        meta = os.path.join(self.run_dir, "latest.json")
+        if not os.path.exists(meta):
+            return None
+        with open(meta) as f:
+            info = json.load(f)
+        if not os.path.exists(info["path"]):
+            return None
+        return Checkpoint(info["path"])

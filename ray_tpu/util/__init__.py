@@ -1,0 +1,7 @@
+from . import scheduling_strategies  # noqa: F401
+from .placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)

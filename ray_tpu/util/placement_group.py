@@ -1,0 +1,78 @@
+"""Placement groups: gang reservation of resources across nodes.
+
+Reference: python/ray/util/placement_group.py (placement_group :146) +
+GCS 2-phase bundle commit (gcs_placement_group_scheduler). On TPU the
+primary use is gang-scheduling all hosts of a slice: bundles with
+``{"TPU": n}`` pack onto one slice's hosts (ICI-contiguous) by the
+label-aware packer in _private/scheduling.py.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .._private.core_worker import global_worker
+from .._private.ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]]):
+        self.id_hex = pg_id
+        self.bundle_specs = bundles
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        return self.wait(timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        worker = global_worker()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = worker.gcs.get_placement_group(pg_id=self.id_hex)
+            if info is not None and info["state"] == "CREATED":
+                return True
+            if info is not None and info["state"] == "REMOVED":
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.02)
+
+    @property
+    def placement(self) -> Optional[List[str]]:
+        info = global_worker().gcs.get_placement_group(pg_id=self.id_hex)
+        return None if info is None else info.get("placement")
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id_hex, self.bundle_specs))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+) -> PlacementGroup:
+    worker = global_worker()
+    pg_id = PlacementGroupID.of(worker.job_id).hex()
+    res = worker.gcs.create_placement_group(
+        spec={
+            "pg_id": pg_id,
+            "job_id": worker.job_id.hex(),
+            "name": name,
+            "bundles": [
+                {k: float(v) for k, v in b.items()} for b in bundles
+            ],
+            "strategy": strategy,
+            "detached": lifetime == "detached",
+        }
+    )
+    if not res.get("ok"):
+        raise ValueError(res.get("error", "placement group creation failed"))
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    global_worker().gcs.remove_placement_group(pg_id=pg.id_hex)
+
+
+def placement_group_table() -> List[dict]:
+    return global_worker().gcs.get_all_placement_groups()

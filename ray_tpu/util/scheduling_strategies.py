@@ -1,0 +1,35 @@
+"""Scheduling strategy objects.
+
+Reference: python/ray/util/scheduling_strategies.py — PlacementGroup (:15),
+NodeAffinity (:41), NodeLabel (:135) strategies, passed to .options().
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(
+        self,
+        placement_group,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks
+        )
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+class NodeLabelSchedulingStrategy:
+    def __init__(self, hard: Optional[Dict[str, str]] = None,
+                 soft: Optional[Dict[str, str]] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}

@@ -4,8 +4,29 @@ Multi-chip TPU hardware is not available in CI; per the build contract all
 mesh/sharding tests run against XLA's host-platform virtual devices
 (mirrors how the reference fakes multi-node clusters on one machine,
 reference: python/ray/cluster_utils.py:135).
+
+If the interpreter started under the TPU site hook (which registers and
+initializes the single-chip backend before any test code runs), environment
+edits come too late — so re-exec once with a clean CPU environment.
 """
 import os
+import sys
+
+_MARK = "_RAY_TPU_TEST_REEXEC"
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") and os.environ.get(_MARK) != "1":
+    env = dict(os.environ)
+    env[_MARK] = "1"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # disable the TPU site hook
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest", *sys.argv[1:]],
+        env,
+    )
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")

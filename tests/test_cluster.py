@@ -1,0 +1,125 @@
+"""Multi-node tests via cluster_utils.Cluster (all nodes are local
+processes, mirroring reference python/ray/cluster_utils.py:135 usage)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_node_args={"resources": {"CPU": 2}})
+    c.add_node(resources={"CPU": 2, "gadget": 1})
+    c.add_node(resources={"CPU": 2})
+    ray.init(address=c.address)
+    yield c
+    ray.shutdown()
+    c.shutdown()
+
+
+@ray.remote
+def whoami():
+    import ray_tpu.api as api
+
+    return api.global_worker().node_id
+
+
+def test_sees_all_nodes(cluster):
+    assert len([n for n in ray.nodes() if n["alive"]]) == 3
+    assert ray.cluster_resources()["CPU"] == 6.0
+
+
+def test_custom_resource_targets_node(cluster):
+    nid = ray.get(whoami.options(resources={"gadget": 1}).remote(), timeout=120)
+    gadget = [
+        n for n in ray.nodes() if n.get("total", {}).get("gadget")
+    ][0]
+    assert nid == gadget["node_id"]
+
+
+def test_node_affinity(cluster):
+    target = ray.nodes()[-1]["node_id"]
+    nid = ray.get(
+        whoami.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(target)
+        ).remote(),
+        timeout=60,
+    )
+    assert nid == target
+
+
+def test_spread_uses_multiple_nodes(cluster):
+    refs = [
+        whoami.options(scheduling_strategy="SPREAD").remote()
+        for _ in range(6)
+    ]
+    assert len(set(ray.get(refs, timeout=150))) >= 2
+
+
+def test_cross_node_object_transfer(cluster):
+    @ray.remote(resources={"gadget": 1})
+    def make():
+        return np.ones(1_000_000, dtype=np.float32)
+
+    @ray.remote
+    def consume(a):
+        return float(a.sum())
+
+    ref = make.remote()
+    # driver pulls from remote node
+    assert float(ray.get(ref, timeout=120).sum()) == 1_000_000.0
+    # another task (anywhere) consumes it
+    assert ray.get(consume.remote(ref), timeout=120) == 1_000_000.0
+
+
+def test_placement_group_strict_spread_and_pinning(cluster):
+    pg = ray.placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    assert len(set(pg.placement)) == 3
+
+    @ray.remote
+    class W:
+        def who(self):
+            import ray_tpu.api as api
+
+            return api.global_worker().node_id
+
+    actors = [
+        W.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg, i)
+        ).remote()
+        for i in range(3)
+    ]
+    whos = ray.get([a.who.remote() for a in actors], timeout=150)
+    assert len(set(whos)) == 3
+    assert sorted(whos) == sorted(pg.placement)
+    for a in actors:
+        ray.kill(a)
+    ray.remove_placement_group(pg)
+
+
+def test_placement_group_resources_released_on_remove(cluster):
+    time.sleep(2.0)  # let prior tests' async releases land in heartbeats
+    before = ray.available_resources().get("CPU", 0)
+    pg = ray.placement_group([{"CPU": 1}] * 2, strategy="PACK")
+    assert pg.ready(timeout=30)
+    time.sleep(1.5)
+    during = ray.available_resources().get("CPU", 0)
+    assert during <= before - 2
+    ray.remove_placement_group(pg)
+    time.sleep(1.5)
+    after = ray.available_resources().get("CPU", 0)
+    assert after >= before - 0.01
+
+
+def test_infeasible_pg_not_created(cluster):
+    pg = ray.placement_group([{"CPU": 100}], strategy="PACK")
+    assert not pg.ready(timeout=2)
+    ray.remove_placement_group(pg)

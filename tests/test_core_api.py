@@ -1,0 +1,257 @@
+"""End-to-end single-node API tests (tasks, objects, actors, errors).
+
+Mirrors the reference's python/ray/tests/test_basic*.py + test_actor*.py
+surface at much smaller scale.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+def test_put_get_roundtrip(ray_start):
+    for value in [1, "s", [1, 2], {"a": (1, 2)}, None, b"bytes"]:
+        assert ray.get(ray.put(value)) == value
+
+
+def test_put_get_numpy_zero_copy(ray_start):
+    arr = np.arange(500_000, dtype=np.float64)  # > inline threshold
+    out = ray.get(ray.put(arr))
+    np.testing.assert_array_equal(out, arr)
+    assert not out.flags.writeable  # zero-copy read-only view
+
+
+def test_simple_task(ray_start):
+    @ray.remote
+    def f(a, b=1):
+        return a + b
+
+    assert ray.get(f.remote(1), timeout=60) == 2
+    assert ray.get(f.remote(1, b=10), timeout=30) == 11
+
+
+def test_many_tasks(ray_start):
+    @ray.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(200)]
+    assert ray.get(refs, timeout=60) == [i * i for i in range(200)]
+
+
+def test_task_dependency_chain(ray_start):
+    @ray.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(5):
+        ref = inc.remote(ref)
+    assert ray.get(ref, timeout=150) == 6
+
+
+def test_large_arg_and_return(ray_start):
+    @ray.remote
+    def double(a):
+        return a * 2
+
+    arr = np.ones(300_000, dtype=np.float32)
+    out = ray.get(double.remote(arr), timeout=60)
+    assert out.shape == arr.shape and out[0] == 2.0
+
+
+def test_multiple_returns(ray_start):
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray.get([r1, r2, r3], timeout=60) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start):
+    @ray.remote(max_retries=0)
+    def boom():
+        raise KeyError("kaput")
+
+    with pytest.raises(ray.RayTaskError, match="kaput"):
+        ray.get(boom.remote(), timeout=60)
+
+
+def test_error_through_dependency(ray_start):
+    @ray.remote(max_retries=0)
+    def boom():
+        raise ValueError("root cause")
+
+    @ray.remote
+    def passthrough(x):
+        return x
+
+    with pytest.raises(ray.RayError):
+        ray.get(passthrough.remote(boom.remote()), timeout=60)
+
+
+def test_wait(ray_start):
+    @ray.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast, slow_ref = slow.remote(0.05), slow.remote(30)
+    ready, pending = ray.wait([fast, slow_ref], num_returns=1, timeout=10)
+    assert ready == [fast] and pending == [slow_ref]
+
+
+def test_get_timeout(ray_start):
+    @ray.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(ray.GetTimeoutError):
+        ray.get(hang.remote(), timeout=0.5)
+
+
+def test_actor_basic_and_ordering(ray_start):
+    @ray.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(100)
+    refs = [c.inc.remote() for _ in range(25)]
+    assert ray.get(refs, timeout=60) == list(range(101, 126))
+
+
+def test_actor_error(ray_start):
+    @ray.remote
+    class E:
+        def bad(self):
+            raise RuntimeError("actor oops")
+
+        def good(self):
+            return "fine"
+
+    e = E.remote()
+    with pytest.raises(ray.RayTaskError, match="actor oops"):
+        ray.get(e.bad.remote(), timeout=60)
+    # actor survives its own exceptions
+    assert ray.get(e.good.remote(), timeout=30) == "fine"
+
+
+def test_actor_handle_passing(ray_start):
+    @ray.remote
+    class Holder:
+        def __init__(self):
+            self.v = 7
+
+        def get(self):
+            return self.v
+
+    @ray.remote
+    def reads(h):
+        return ray.get(h.get.remote(), timeout=30)
+
+    h = Holder.remote()
+    assert ray.get(reads.remote(h), timeout=60) == 7
+
+
+def test_named_detached_actor(ray_start):
+    @ray.remote
+    class Reg:
+        def ping(self):
+            return "pong"
+
+    Reg.options(name="reg", lifetime="detached").remote()
+    h = ray.get_actor("reg")
+    assert ray.get(h.ping.remote(), timeout=60) == "pong"
+    with pytest.raises(ValueError):
+        ray.get_actor("missing")
+
+
+def test_async_actor(ray_start):
+    import asyncio
+
+    @ray.remote
+    class AsyncActor:
+        async def work(self, t, tag):
+            await asyncio.sleep(t)
+            return tag
+
+    a = AsyncActor.remote()
+    # both run concurrently on the actor's event loop
+    t0 = time.time()
+    r = ray.get([a.work.remote(0.5, 1), a.work.remote(0.5, 2)], timeout=60)
+    assert r == [1, 2]
+    assert time.time() - t0 < 5.0
+
+
+def test_max_concurrency_threaded_actor(ray_start):
+    @ray.remote(max_concurrency=4)
+    class Threaded:
+        def block(self, t):
+            time.sleep(t)
+            return os.getpid()
+
+    a = Threaded.remote()
+    t0 = time.time()
+    ray.get([a.block.remote(0.4) for _ in range(4)], timeout=60)
+    assert time.time() - t0 < 5.0  # ran concurrently, not 1.6s serial
+
+
+def test_kill_actor(ray_start):
+    @ray.remote
+    class K:
+        def hi(self):
+            return "hi"
+
+    k = K.remote()
+    assert ray.get(k.hi.remote(), timeout=60) == "hi"
+    ray.kill(k)
+    time.sleep(0.5)
+    with pytest.raises(ray.RayActorError):
+        ray.get(k.hi.remote(), timeout=15)
+
+
+def test_cluster_resources_api(ray_start):
+    total = ray.cluster_resources()
+    assert total.get("CPU") == 8.0
+    assert len(ray.nodes()) == 1
+
+
+def test_nested_tasks(ray_start):
+    @ray.remote
+    def inner(x):
+        return x * 10
+
+    @ray.remote
+    def outer(x):
+        return ray.get(inner.remote(x), timeout=30) + 1
+
+    assert ray.get(outer.remote(4), timeout=60) == 41
+
+
+def test_ref_in_container_borrowed(ray_start):
+    @ray.remote
+    def make():
+        return "payload"
+
+    @ray.remote
+    def open_box(box):
+        return ray.get(box["ref"], timeout=30)
+
+    ref = make.remote()
+    assert ray.get(open_box.remote({"ref": ref}), timeout=60) == "payload"

@@ -1,0 +1,161 @@
+"""Parallel layer tests on the 8-device virtual CPU mesh (conftest forces
+xla_force_host_platform_device_count=8; mirrors how reference CI fakes
+multi-node — SURVEY §4 implication (c))."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ray_tpu.ops.attention import attention_block, flash_attention
+from ray_tpu.parallel import (
+    MeshSpec,
+    create_mesh,
+    logical_sharding,
+    ring_attention,
+    ulysses_attention,
+)
+from ray_tpu.parallel.mesh import mesh_shape
+
+
+def reference_attention(q, k, v, causal=True):
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        k = np.repeat(k, H // Hkv, axis=2)
+        v = np.repeat(v, H // Hkv, axis=2)
+    scores = np.einsum("bshd,bthd->bhst", q, k).astype(np.float64) * (D**-0.5)
+    if causal:
+        mask = np.tril(np.ones((S, S), dtype=bool))
+        scores = np.where(mask[None, None], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhst,bthd->bshd", p, v)
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec(fsdp=-1).resolve(8) == {
+        "data": 1, "fsdp": 8, "expert": 1, "tensor": 1, "seq": 1
+    }
+    assert MeshSpec(data=2, fsdp=-1, tensor=2).resolve(8)["fsdp"] == 2
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+
+
+def test_create_mesh_axes():
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    assert mesh_shape(mesh) == {
+        "data": 2, "fsdp": 2, "expert": 1, "tensor": 1, "seq": 1
+    } or mesh_shape(mesh)["tensor"] == 2
+
+
+def test_logical_sharding_rules():
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    s = logical_sharding(mesh, ("batch", "act_seq", "act_embed"))
+    assert s.spec == P(("data", "fsdp"), "seq", None)
+    s2 = logical_sharding(mesh, ("embed", "mlp"))
+    assert s2.spec == P("fsdp", "tensor")
+
+
+def test_attention_block_matches_reference():
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((2, 16, 4, 8), dtype=np.float32)
+    k = rng.standard_normal((2, 16, 4, 8), dtype=np.float32)
+    v = rng.standard_normal((2, 16, 4, 8), dtype=np.float32)
+    o, m, l = attention_block(jnp.array(q), jnp.array(k), jnp.array(v))
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(o), ref, atol=1e-4)
+
+
+def test_flash_attention_causal_matches_reference():
+    rng = np.random.default_rng(1)
+    q = rng.standard_normal((2, 32, 4, 16), dtype=np.float32)
+    k = rng.standard_normal((2, 32, 2, 16), dtype=np.float32)  # GQA
+    v = rng.standard_normal((2, 32, 2, 16), dtype=np.float32)
+    out = flash_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                          causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = create_mesh(MeshSpec(fsdp=1, seq=8, data=1))
+    rng = np.random.default_rng(2)
+    B, S, H, D = 2, 64, 4, 16
+    q = rng.standard_normal((B, S, H, D), dtype=np.float32)
+    k = rng.standard_normal((B, S, H, D), dtype=np.float32)
+    v = rng.standard_normal((B, S, H, D), dtype=np.float32)
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
+                                       causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+    )
+    out = jax.jit(ring)(jnp.array(q), jnp.array(k), jnp.array(v))
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+
+def test_ring_attention_gqa():
+    mesh = create_mesh(MeshSpec(fsdp=1, seq=4, data=2))
+    rng = np.random.default_rng(3)
+    B, S, H, Hkv, D = 2, 32, 8, 2, 16
+    q = rng.standard_normal((B, S, H, D), dtype=np.float32)
+    k = rng.standard_normal((B, S, Hkv, D), dtype=np.float32)
+    v = rng.standard_normal((B, S, Hkv, D), dtype=np.float32)
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq", causal=True),
+        mesh=mesh,
+        in_specs=(P("data", "seq"), P("data", "seq"), P("data", "seq")),
+        out_specs=P("data", "seq"),
+    )
+    out = jax.jit(ring)(jnp.array(q), jnp.array(k), jnp.array(v))
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(causal):
+    mesh = create_mesh(MeshSpec(fsdp=1, seq=4, data=2))
+    rng = np.random.default_rng(4)
+    B, S, H, D = 2, 32, 8, 16
+    q = rng.standard_normal((B, S, H, D), dtype=np.float32)
+    k = rng.standard_normal((B, S, H, D), dtype=np.float32)
+    v = rng.standard_normal((B, S, H, D), dtype=np.float32)
+    uly = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq",
+                                          causal=causal),
+        mesh=mesh,
+        in_specs=(P("data", "seq"), P("data", "seq"), P("data", "seq")),
+        out_specs=P("data", "seq"),
+    )
+    out = jax.jit(uly)(jnp.array(q), jnp.array(k), jnp.array(v))
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+
+
+def test_device_collectives():
+    from ray_tpu.parallel.collectives import (
+        allgather, allreduce, broadcast, reducescatter,
+    )
+
+    mesh = create_mesh(MeshSpec(fsdp=8))
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return allreduce(x, "fsdp")
+
+    out = shard_map(body, mesh=mesh, in_specs=P("fsdp"),
+                    out_specs=P("fsdp"))(x)
+    assert np.asarray(out).sum() == pytest.approx(8 * x.sum() / 8 * 8)
+
+    def bcast(x):
+        return broadcast(x, "fsdp", root=3)
+
+    out = shard_map(bcast, mesh=mesh, in_specs=P("fsdp"),
+                    out_specs=P("fsdp"))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))

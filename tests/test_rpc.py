@@ -1,0 +1,105 @@
+"""RPC layer tests: request/response, errors, retries, chaos injection.
+
+Mirrors reference grpc tests + rpc_chaos.cc behavior.
+"""
+import asyncio
+
+import pytest
+
+from ray_tpu._private import rpc as rpc_mod
+from ray_tpu._private.rpc import (
+    EventLoopThread,
+    RpcApplicationError,
+    RpcClient,
+    RpcServer,
+)
+
+
+class Service:
+    async def echo(self, value):
+        return value
+
+    async def fail(self):
+        raise ValueError("expected failure")
+
+    async def add(self, a, b):
+        return a + b
+
+
+@pytest.fixture
+def server():
+    loop = EventLoopThread.get()
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register(Service())
+    loop.run(srv.start())
+    yield srv
+    loop.run(srv.stop())
+
+
+def test_echo_roundtrip(server):
+    cli = RpcClient(*server.address)
+    assert cli.call_sync("echo", value={"x": [1, 2, 3]}) == {"x": [1, 2, 3]}
+    assert cli.call_sync("add", a=2, b=3) == 5
+    cli.close_sync()
+
+
+def test_application_error_propagates(server):
+    cli = RpcClient(*server.address)
+    with pytest.raises(RpcApplicationError, match="expected failure"):
+        cli.call_sync("fail")
+    cli.close_sync()
+
+
+def test_unknown_method(server):
+    cli = RpcClient(*server.address)
+    with pytest.raises(RpcApplicationError, match="no such method"):
+        cli.call_sync("nope")
+    cli.close_sync()
+
+
+def test_concurrent_calls(server):
+    cli = RpcClient(*server.address)
+    loop = EventLoopThread.get()
+
+    async def many():
+        return await asyncio.gather(
+            *[cli.call("add", a=i, b=i) for i in range(50)]
+        )
+
+    assert loop.run(many()) == [2 * i for i in range(50)]
+    cli.close_sync()
+
+
+def test_large_payload(server):
+    cli = RpcClient(*server.address)
+    blob = b"x" * (8 * 1024 * 1024)
+    assert cli.call_sync("echo", value=blob) == blob
+    cli.close_sync()
+
+
+def test_connection_error_retries_then_raises():
+    cli = RpcClient("127.0.0.1", 1, retries=1)
+    with pytest.raises(rpc_mod.RpcConnectionError):
+        cli.call_sync("echo", value=1)
+    cli.close_sync()
+
+
+def test_chaos_injection(monkeypatch, server):
+    """RAY_TPU config testing_rpc_failure injects failures per method
+    (reference: rpc_chaos.cc:33, RAY_testing_rpc_failure)."""
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    old = cfg.testing_rpc_failure
+    cfg.testing_rpc_failure = "echo:1.0"
+    rpc_mod.reset_chaos()
+    try:
+        cli = RpcClient(*server.address, retries=0)
+        with pytest.raises(rpc_mod.RpcConnectionError):
+            cli.call_sync("echo", value=1)
+        # other methods unaffected
+        assert cli.call_sync("add", a=1, b=1) == 2
+        cli.close_sync()
+    finally:
+        cfg.testing_rpc_failure = old
+        rpc_mod.reset_chaos()

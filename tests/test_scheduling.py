@@ -1,0 +1,139 @@
+"""Unit tests for the cluster resource model + policies (no processes).
+
+Mirrors reference tests: cluster_resource_scheduler_test.cc,
+hybrid_scheduling_policy_test.cc, bundle tests in
+gcs_placement_group_scheduler tests.
+"""
+import pytest
+
+from ray_tpu._private.scheduling import (
+    ClusterResourceScheduler,
+    NodeView,
+    SchedulingRequest,
+    pack_bundles,
+)
+
+
+def make_nodes(n, cpu=4.0, labels=None):
+    nodes = {}
+    for i in range(n):
+        nid = f"node{i}"
+        nodes[nid] = NodeView(
+            node_id=nid,
+            address=("127.0.0.1", 1000 + i),
+            total={"CPU": cpu},
+            available={"CPU": cpu},
+            labels=(labels or {}).get(nid, {}),
+        )
+    return nodes
+
+
+def test_hybrid_packs_until_threshold():
+    nodes = make_nodes(3)
+    sched = ClusterResourceScheduler(
+        local_node_id="node0", spread_threshold=0.5, seed=0
+    )
+    req = SchedulingRequest(demand={"CPU": 1.0})
+    # Local node preferred while below threshold.
+    assert sched.pick_node(nodes, req) == "node0"
+    nodes["node0"].available["CPU"] = 1.0  # util would be 1.0 > threshold
+    pick = sched.pick_node(nodes, req)
+    assert pick in ("node1", "node2")
+
+
+def test_infeasible_returns_none():
+    nodes = make_nodes(2, cpu=2.0)
+    sched = ClusterResourceScheduler()
+    assert sched.pick_node(nodes, SchedulingRequest(demand={"CPU": 16.0})) is None
+    assert not sched.feasible_anywhere(
+        nodes, SchedulingRequest(demand={"CPU": 16.0})
+    )
+    assert sched.feasible_anywhere(
+        nodes, SchedulingRequest(demand={"CPU": 2.0})
+    )
+
+
+def test_node_affinity_hard_and_soft():
+    nodes = make_nodes(2)
+    sched = ClusterResourceScheduler()
+    req = SchedulingRequest(
+        demand={"CPU": 1.0}, strategy="NodeAffinity", affinity_node_id="node1"
+    )
+    assert sched.pick_node(nodes, req) == "node1"
+    nodes["node1"].available["CPU"] = 0.0
+    assert sched.pick_node(nodes, req) is None  # hard affinity
+    req.affinity_soft = True
+    assert sched.pick_node(nodes, req) == "node0"
+
+
+def test_label_selector():
+    nodes = make_nodes(3, labels={"node2": {"tpu-slice-name": "s1"}})
+    sched = ClusterResourceScheduler()
+    req = SchedulingRequest(
+        demand={"CPU": 1.0}, label_selector={"tpu-slice-name": "s1"}
+    )
+    assert sched.pick_node(nodes, req) == "node2"
+
+
+def test_spread_round_robins():
+    nodes = make_nodes(3)
+    sched = ClusterResourceScheduler()
+    req = SchedulingRequest(demand={"CPU": 1.0}, strategy="SPREAD")
+    picks = {sched.pick_node(nodes, req) for _ in range(6)}
+    assert len(picks) == 3
+
+
+def test_dead_nodes_skipped():
+    nodes = make_nodes(2)
+    nodes["node0"].alive = False
+    sched = ClusterResourceScheduler()
+    assert sched.pick_node(nodes, SchedulingRequest(demand={"CPU": 1.0})) == "node1"
+
+
+# --- bundle packing ---------------------------------------------------------
+def test_pack_bundles_strict_pack():
+    nodes = make_nodes(2, cpu=4.0)
+    placement = pack_bundles(nodes, [{"CPU": 2.0}, {"CPU": 2.0}], "STRICT_PACK")
+    assert placement is not None
+    assert len(set(placement)) == 1
+    assert pack_bundles(nodes, [{"CPU": 3.0}, {"CPU": 3.0}], "STRICT_PACK") is None
+
+
+def test_pack_bundles_strict_spread():
+    nodes = make_nodes(3, cpu=2.0)
+    placement = pack_bundles(
+        nodes, [{"CPU": 1.0}] * 3, "STRICT_SPREAD"
+    )
+    assert placement is not None and len(set(placement)) == 3
+    assert pack_bundles(nodes, [{"CPU": 1.0}] * 4, "STRICT_SPREAD") is None
+
+
+def test_pack_bundles_pack_fills_one_node_first():
+    nodes = make_nodes(2, cpu=4.0)
+    placement = pack_bundles(nodes, [{"CPU": 1.0}] * 4, "PACK")
+    assert placement is not None
+    assert len(set(placement)) == 1
+
+
+def test_pack_prefers_same_tpu_slice():
+    """ICI-aware gang packing: bundles land on one slice when possible."""
+    nodes = make_nodes(
+        4,
+        cpu=2.0,
+        labels={
+            "node0": {"tpu-slice-name": "sliceA"},
+            "node1": {"tpu-slice-name": "sliceB"},
+            "node2": {"tpu-slice-name": "sliceA"},
+            "node3": {"tpu-slice-name": "sliceB"},
+        },
+    )
+    placement = pack_bundles(nodes, [{"CPU": 2.0}] * 2, "PACK")
+    slices = {
+        nodes[nid].labels.get("tpu-slice-name") for nid in placement
+    }
+    assert len(slices) == 1
+
+
+def test_pack_bundles_infeasible():
+    nodes = make_nodes(2, cpu=1.0)
+    assert pack_bundles(nodes, [{"CPU": 8.0}], "PACK") is None
