@@ -112,6 +112,13 @@ class ObjectRef:
         return self.id.task_id()
 
     def __reduce__(self):
+        # Mark the owner record: a pickled ref may be in flight to a new
+        # borrower, so its free must wait out a grace window.
+        w = _global_worker
+        if w is not None:
+            rec = w._records.get(self.id.binary())
+            if rec is not None:
+                rec.serialized_out = True
         return (_rehydrate_ref, (self.id.binary(), self.owner_address))
 
     def __hash__(self):
@@ -205,7 +212,7 @@ _IN_SHM = _Sentinel()
 class _ObjectRecord:
     __slots__ = (
         "local_refs", "borrowers", "locations", "size", "pending",
-        "error", "lineage_task_id", "event",
+        "error", "lineage_task_id", "event", "serialized_out",
     )
 
     def __init__(self):
@@ -217,6 +224,8 @@ class _ObjectRecord:
         self.error: Optional[bytes] = None  # serialized exception
         self.lineage_task_id: Optional[bytes] = None
         self.event = threading.Event()
+        # True once the ref was pickled (could be in flight to a borrower)
+        self.serialized_out = False
 
 
 # ---------------------------------------------------------------------------
@@ -316,8 +325,36 @@ class CoreWorker:
 
     def shutdown(self):
         self._exit.set()
+        self._flush_pending_frees()
         try:
             EventLoopThread.get().run(self._server.stop(), 5.0)
+        except Exception:
+            pass
+
+    def _flush_pending_frees(self):
+        """Synchronously delete remote shm copies of dead owned objects —
+        grace-window timers would be lost with the process."""
+        doomed: Dict[str, list] = {}
+        with self._records_lock:
+            for oid_bytes, rec in list(self._records.items()):
+                if (
+                    rec.local_refs <= 0
+                    and rec.borrowers <= 0
+                    and not rec.pending
+                ):
+                    for node_id in rec.locations:
+                        doomed.setdefault(node_id, []).append(oid_bytes)
+                    self._records.pop(oid_bytes, None)
+        if not doomed:
+            return
+        try:
+            view = self.gcs.get_cluster_view(timeout=3.0)
+            for node_id, oids in doomed.items():
+                info = view.get(node_id)
+                if info is None or not info.get("alive"):
+                    continue
+                cli = self._pool.get(*info["address"])
+                cli.call_sync("delete_objects", object_ids=oids, timeout=3.0)
         except Exception:
             pass
         self._pool.close_all()
@@ -648,14 +685,35 @@ class CoreWorker:
         return True
 
     def _free_object(self, oid: ObjectID, rec: _ObjectRecord):
+        """Free now if no pickled copy can be in flight; otherwise wait out
+        a grace window for the borrower registration to land (the reference's
+        borrowing protocol confirms synchronously at deserialization; we
+        approximate with async registration + grace)."""
+        if not rec.serialized_out:
+            self._free_now(oid, rec)
+        else:
+            EventLoopThread.get().spawn(self._free_after_grace(oid))
+
+    def _free_now(self, oid: ObjectID, rec: _ObjectRecord):
         self._records.pop(oid.binary(), None)
         self.memory_store.delete(oid)
         if rec.locations:
-            # Fire-and-forget shm deletion on every node holding a copy.
-            # Must not block: this can run on the io thread (borrower RPC).
             EventLoopThread.get().spawn(
                 self._free_shm_copies(oid.binary(), set(rec.locations))
             )
+
+    async def _free_after_grace(self, oid: ObjectID):
+        await asyncio.sleep(5.0)
+        with self._records_lock:
+            rec = self._records.get(oid.binary())
+            if rec is None:
+                return
+            if rec.local_refs > 0 or rec.borrowers > 0 or rec.pending:
+                return  # resurrected by a late borrower
+            self._records.pop(oid.binary(), None)
+        self.memory_store.delete(oid)
+        if rec.locations:
+            await self._free_shm_copies(oid.binary(), set(rec.locations))
 
     async def _free_shm_copies(self, oid_bytes: bytes, locations: set):
         try:

@@ -1,0 +1,21 @@
+"""ray_tpu.data — distributed datasets with a streaming executor.
+
+Reference: python/ray/data/ (SURVEY §2.4 row 1): lazy logical plan →
+optimizer (map fusion) → streaming executor with bounded in-flight tasks →
+Arrow blocks in the shared-memory object store.
+"""
+from .block import Block, BlockAccessor  # noqa: F401
+from .context import DataContext  # noqa: F401
+from .dataset import Dataset, GroupedDataset  # noqa: F401
+from .datasource import (  # noqa: F401
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_parquet,
+    read_text,
+)

@@ -1,0 +1,175 @@
+"""Blocks: the unit of data movement (reference: python/ray/data/block.py,
+_internal/arrow_block.py, pandas_block.py).
+
+A block is a pyarrow.Table (columnar, zero-copy through the shm object
+store) — or a plain Python list for simple/object rows. BlockAccessor
+normalizes both.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+Block = Union["pa.Table", List[Any]]
+
+
+def _is_table(block) -> bool:
+    return pa is not None and isinstance(block, pa.Table)
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        if _is_table(self._block):
+            return self._block.num_rows
+        return len(self._block)
+
+    def size_bytes(self) -> int:
+        if _is_table(self._block):
+            return self._block.nbytes
+        import sys
+
+        return sum(sys.getsizeof(r) for r in self._block)
+
+    def schema(self):
+        if _is_table(self._block):
+            return self._block.schema
+        if self._block:
+            first = self._block[0]
+            if isinstance(first, dict):
+                return {k: type(v).__name__ for k, v in first.items()}
+            return type(first).__name__
+        return None
+
+    def slice(self, start: int, end: int) -> Block:
+        if _is_table(self._block):
+            return self._block.slice(start, end - start)
+        return self._block[start:end]
+
+    def iter_rows(self) -> Iterable[Any]:
+        if _is_table(self._block):
+            for batch in self._block.to_batches():
+                cols = batch.to_pydict()
+                keys = list(cols)
+                for i in range(batch.num_rows):
+                    yield {k: cols[k][i] for k in keys}
+        else:
+            yield from self._block
+
+    def to_pandas(self):
+        import pandas as pd
+
+        if _is_table(self._block):
+            return self._block.to_pandas()
+        rows = list(self._block)
+        if rows and isinstance(rows[0], dict):
+            return pd.DataFrame(rows)
+        return pd.DataFrame({"item": rows})
+
+    def to_arrow(self) -> "pa.Table":
+        if _is_table(self._block):
+            return self._block
+        return rows_to_block(list(self._block), prefer_arrow=True)
+
+    def to_numpy(self, column: Optional[str] = None):
+        if _is_table(self._block):
+            if column is not None:
+                return self._block.column(column).to_numpy(
+                    zero_copy_only=False
+                )
+            return {
+                name: self._block.column(name).to_numpy(zero_copy_only=False)
+                for name in self._block.column_names
+            }
+        rows = list(self._block)
+        if rows and isinstance(rows[0], dict):
+            if column is not None:
+                return np.asarray([r[column] for r in rows])
+            return {
+                k: np.asarray([r[k] for r in rows]) for k in rows[0].keys()
+            }
+        return np.asarray(rows)
+
+    def to_batch_format(self, batch_format: str):
+        if batch_format in ("numpy", "default", None):
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.to_arrow()
+        if batch_format == "rows":
+            return list(self.iter_rows())
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    @staticmethod
+    def combine(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0] or (
+            blocks[:1]
+        )
+        if not blocks:
+            return []
+        if all(_is_table(b) for b in blocks):
+            return pa.concat_tables(blocks, promote_options="default")
+        out: List[Any] = []
+        for b in blocks:
+            out.extend(BlockAccessor(b).iter_rows())
+        return out
+
+
+def rows_to_block(rows: List[Any], prefer_arrow: bool = True) -> Block:
+    """Build a block from Python rows (dicts become arrow when possible)."""
+    if (
+        prefer_arrow
+        and pa is not None
+        and rows
+        and all(isinstance(r, dict) for r in rows)
+    ):
+        try:
+            return pa.Table.from_pylist(rows)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            pass
+    return list(rows)
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Normalize a user map_batches return value into a block."""
+    import sys
+
+    if _is_table(batch):
+        return batch
+    pd = sys.modules.get("pandas")  # only loaded if the user produced a df
+    if pd is not None and isinstance(batch, pd.DataFrame):
+        return pa.Table.from_pandas(batch, preserve_index=False) if pa else batch
+    if isinstance(batch, dict):
+        # dict of column -> array
+        if pa is not None:
+            try:
+                return pa.Table.from_pydict(
+                    {
+                        k: (v.tolist() if isinstance(v, np.ndarray) and v.ndim > 1 else v)
+                        for k, v in batch.items()
+                    }
+                )
+            except Exception:
+                pass
+        n = len(next(iter(batch.values())))
+        return [
+            {k: batch[k][i] for k in batch} for i in range(n)
+        ]
+    if isinstance(batch, list):
+        return rows_to_block(batch)
+    if isinstance(batch, np.ndarray):
+        return rows_to_block([{"data": row} for row in batch])
+    raise TypeError(f"cannot convert {type(batch)} to a block")

@@ -1,0 +1,26 @@
+"""DataContext: per-process execution configuration.
+
+Reference: python/ray/data/context.py (DataContext.get_current()).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # streaming executor: max concurrently in-flight tasks per operator
+    max_tasks_in_flight: int = 8
+    # rows per read task when the source has no natural partitioning
+    default_read_parallelism: int = 8
+    default_batch_format: str = "numpy"
+
+    _current = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = cls()
+        return cls._current

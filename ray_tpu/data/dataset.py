@@ -1,0 +1,277 @@
+"""Dataset: the lazy user-facing API.
+
+Reference: python/ray/data/dataset.py:162 — every method appends a logical
+op (map_batches :451, iter_batches :4710, materialize :5672); execution is
+deferred to the streaming executor. streaming_split feeds per-host Train
+ingest (reference: _internal/split.py).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union as TUnion
+
+import numpy as np
+
+import ray_tpu as ray
+
+from .block import BlockAccessor, batch_to_block, rows_to_block
+from .context import DataContext
+from .executor import StreamingExecutor, _meta_of
+from .plan import AllToAll, InputBlocks, Limit, LogicalPlan, MapBlocks, Read, Union
+
+
+def _batch_transform(fn, batch_format, batch_size):
+    """Wrap a user batch fn into a block->blocks transform."""
+
+    def transform(block):
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        if n == 0:
+            return [rows_to_block([])]  # never call the UDF on empty input
+        out = []
+        step = batch_size or n
+        for start in range(0, n, step):
+            piece = acc.slice(start, min(start + step, n))
+            batch = BlockAccessor.for_block(piece).to_batch_format(
+                batch_format
+            )
+            out.append(batch_to_block(fn(batch)))
+        return out
+
+    return transform
+
+
+def _row_transform(kind: str, fn):
+    def transform(block):
+        rows_out: List[Any] = []
+        for row in BlockAccessor.for_block(block).iter_rows():
+            if kind == "map":
+                rows_out.append(fn(row))
+            elif kind == "filter":
+                if fn(row):
+                    rows_out.append(row)
+            elif kind == "flat_map":
+                rows_out.extend(fn(row))
+        return [rows_to_block(rows_out)]
+
+    return transform
+
+
+class Dataset:
+    def __init__(self, plan: LogicalPlan):
+        self._plan = plan
+
+    # ------------------------------------------------------------------
+    # transforms (lazy)
+    # ------------------------------------------------------------------
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="Map", fn=_row_transform("map", fn))
+        ))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="Filter", fn=_row_transform("filter", fn))
+        ))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return Dataset(self._plan.with_op(
+            MapBlocks(name="FlatMap", fn=_row_transform("flat_map", fn))
+        ))
+
+    def map_batches(
+        self,
+        fn: TUnion[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: Optional[str] = None,
+        compute: Optional[int] = None,  # actor pool size for class UDFs
+        fn_constructor_args: tuple = (),
+        concurrency: Optional[int] = None,
+    ) -> "Dataset":
+        batch_format = batch_format or DataContext.get_current().default_batch_format
+        if isinstance(fn, type):
+            pool = concurrency or compute or 2
+
+            def actor_fn(udf, block):
+                return _batch_transform(udf, batch_format, batch_size)(block)
+
+            op = MapBlocks(
+                name=f"MapBatches({fn.__name__})",
+                fn=actor_fn,
+                actor_cls=fn,
+                actor_pool_size=pool,
+                fn_args=fn_constructor_args,
+            )
+        else:
+            op = MapBlocks(
+                name="MapBatches",
+                fn=_batch_transform(fn, batch_format, batch_size),
+            )
+        return Dataset(self._plan.with_op(op))
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(self._plan.with_op(Limit(name=f"Limit[{n}]", n=n)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(self._plan.with_op(AllToAll(
+            name=f"Repartition[{num_blocks}]", kind="repartition",
+            params={"num_blocks": num_blocks},
+        )))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(self._plan.with_op(AllToAll(
+            name="RandomShuffle", kind="random_shuffle",
+            params={"seed": seed},
+        )))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return Dataset(self._plan.with_op(AllToAll(
+            name=f"Sort[{key}]", kind="sort",
+            params={"key": key, "descending": descending},
+        )))
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(self._plan.with_op(Union(
+            name="Union", others=[o._plan for o in others]
+        )))
+
+    # ------------------------------------------------------------------
+    # consumption (triggers execution)
+    # ------------------------------------------------------------------
+    def _execute(self):
+        return StreamingExecutor().execute(self._plan)
+
+    def iter_internal_refs(self):
+        return self._execute()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for ref, meta in self._execute():
+            block = ray.get(ref, timeout=600)
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        batch_format: Optional[str] = None,
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        batch_format = batch_format or DataContext.get_current().default_batch_format
+        carry: List[Any] = []
+        for ref, meta in self._execute():
+            block = ray.get(ref, timeout=600)
+            carry.extend(BlockAccessor.for_block(block).iter_rows())
+            while len(carry) >= batch_size:
+                piece = rows_to_block(carry[:batch_size])
+                carry = carry[batch_size:]
+                yield BlockAccessor.for_block(piece).to_batch_format(
+                    batch_format
+                )
+        if carry and not drop_last:
+            piece = rows_to_block(carry)
+            yield BlockAccessor.for_block(piece).to_batch_format(batch_format)
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(meta["num_rows"] for _, meta in self._execute())
+
+    def schema(self):
+        for ref, meta in self._execute():
+            block = ray.get(ref, timeout=600)
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() > 0:
+                return acc.schema()
+        return None
+
+    def materialize(self) -> "Dataset":
+        blocks = list(self._execute())
+        return Dataset(LogicalPlan([InputBlocks(name="Input", blocks=blocks)]))
+
+    def num_blocks(self) -> int:
+        return len(list(self._execute()))
+
+    def size_bytes(self) -> int:
+        return sum(m["size_bytes"] for _, m in self._execute())
+
+    # ------------------------------------------------------------------
+    # splits (Train ingest; reference: _internal/split.py + streaming_split)
+    # ------------------------------------------------------------------
+    def split(self, n: int) -> List["Dataset"]:
+        blocks = list(self.repartition(n)._execute())
+        per = max(1, len(blocks) // n)
+        out = []
+        for i in range(n):
+            chunk = blocks[i * per: (i + 1) * per] if i < n - 1 else blocks[
+                (n - 1) * per:
+            ]
+            out.append(Dataset(LogicalPlan(
+                [InputBlocks(name=f"Split[{i}]", blocks=chunk)]
+            )))
+        return out
+
+    def streaming_split(self, n: int) -> List["Dataset"]:
+        return self.split(n)
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+    def write_parquet(self, path: str):
+        from .datasource import write_blocks
+
+        write_blocks(self, path, "parquet")
+
+    def write_csv(self, path: str):
+        from .datasource import write_blocks
+
+        write_blocks(self, path, "csv")
+
+    def write_json(self, path: str):
+        from .datasource import write_blocks
+
+        write_blocks(self, path, "json")
+
+    def __repr__(self):
+        return f"Dataset({self._plan!r})"
+
+
+class GroupedDataset:
+    """Reference: ray.data.grouped_data.GroupedData."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs) -> Dataset:
+        return Dataset(self._ds._plan.with_op(AllToAll(
+            name=f"GroupBy[{self._key}]", kind="groupby",
+            params={"key": self._key, "aggs": aggs},
+        )))
+
+    def count(self) -> Dataset:
+        return self._agg([("count()", None, "count")])
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg([(f"sum({col})", col, "sum")])
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg([(f"mean({col})", col, "mean")])
+
+    def min(self, col: str) -> Dataset:
+        return self._agg([(f"min({col})", col, "min")])
+
+    def max(self, col: str) -> Dataset:
+        return self._agg([(f"max({col})", col, "max")])

@@ -1,0 +1,210 @@
+"""Datasources: read_* / from_* / write_* (reference: data/read_api.py +
+datasource/).
+
+Read functions build Read logical ops whose read tasks run remotely and
+return blocks; file formats ride pyarrow.
+"""
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import rows_to_block
+from .context import DataContext
+from .dataset import Dataset
+from .plan import InputBlocks, LogicalPlan, Read
+
+
+def _make_dataset(read_tasks, name) -> Dataset:
+    return Dataset(LogicalPlan([Read(name=name, read_tasks=read_tasks)]))
+
+
+import builtins as _builtins
+
+builtins_range = _builtins.range
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    par = parallelism if parallelism > 0 else (
+        DataContext.get_current().default_read_parallelism
+    )
+    par = max(1, min(par, n)) if n else 1
+    bounds = [(n * i // par, n * (i + 1) // par) for i in builtins_range(par)]
+
+    def make_task(lo, hi):
+        def task():
+            return [rows_to_block([{"id": i} for i in builtins_range(lo, hi)])]
+
+        return task
+
+    return _make_dataset(
+        [make_task(lo, hi) for lo, hi in bounds], f"Range[{n}]"
+    )
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    par = parallelism if parallelism > 0 else (
+        DataContext.get_current().default_read_parallelism
+    )
+    par = max(1, min(par, len(items) or 1))
+    n = len(items)
+    # contiguous chunks: row order must be preserved (same as range())
+    bounds = [(n * i // par, n * (i + 1) // par) for i in builtins_range(par)]
+    rows_chunks = [
+        [
+            it if isinstance(it, dict) else {"item": it}
+            for it in items[lo:hi]
+        ]
+        for lo, hi in bounds
+    ]
+
+    def make_task(rows):
+        def task():
+            return [rows_to_block(rows)]
+
+        return task
+
+    return _make_dataset(
+        [make_task(rows) for rows in rows_chunks if rows] or
+        [make_task([])],
+        f"FromItems[{len(items)}]",
+    )
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    return Dataset(LogicalPlan([InputBlocks(name="FromPandas",
+                                            blocks=[table])]))
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset(LogicalPlan([InputBlocks(name="FromArrow",
+                                            blocks=[table])]))
+
+
+def from_numpy(arr: np.ndarray) -> Dataset:
+    rows = [{"data": row} for row in arr]
+    return from_items(rows)
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f)
+                    for f in files
+                    if suffix is None or f.endswith(suffix)
+                )
+        elif any(ch in p for ch in "*?["):
+            out.extend(globlib.glob(p))
+        else:
+            out.append(p)
+    return sorted(out)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def make_task(path):
+        def task():
+            import pyarrow.parquet as pq
+
+            return [pq.read_table(path, columns=columns)]
+
+        return task
+
+    return _make_dataset([make_task(f) for f in files],
+                         f"ReadParquet[{len(files)}]")
+
+
+def read_csv(paths, **csv_opts) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def make_task(path):
+        def task():
+            import pyarrow.csv as pacsv
+
+            return [pacsv.read_csv(path)]
+
+        return task
+
+    return _make_dataset([make_task(f) for f in files],
+                         f"ReadCSV[{len(files)}]")
+
+
+def read_json(paths) -> Dataset:
+    files = _expand_paths(paths, None)
+
+    def make_task(path):
+        def task():
+            import pyarrow.json as pajson
+
+            return [pajson.read_json(path)]
+
+        return task
+
+    return _make_dataset([make_task(f) for f in files],
+                         f"ReadJSON[{len(files)}]")
+
+
+def read_binary_files(paths) -> Dataset:
+    files = _expand_paths(paths, None)
+
+    def make_task(path):
+        def task():
+            with open(path, "rb") as f:
+                return [rows_to_block([{"path": path, "bytes": f.read()}])]
+
+        return task
+
+    return _make_dataset([make_task(f) for f in files],
+                         f"ReadBinary[{len(files)}]")
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths, None)
+
+    def make_task(path):
+        def task():
+            with open(path) as f:
+                return [rows_to_block([{"text": line.rstrip("\n")}
+                                       for line in f])]
+
+        return task
+
+    return _make_dataset([make_task(f) for f in files],
+                         f"ReadText[{len(files)}]")
+
+
+# ---------------------------------------------------------------------------
+def write_blocks(ds: Dataset, path: str, fmt: str):
+    import ray_tpu as ray
+
+    from .block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    for i, (ref, _meta) in enumerate(ds.iter_internal_refs()):
+        block = ray.get(ref, timeout=600)
+        acc = BlockAccessor.for_block(block)
+        fname = os.path.join(path, f"part-{i:05d}.{fmt}")
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(acc.to_arrow(), fname)
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            pacsv.write_csv(acc.to_arrow(), fname)
+        elif fmt == "json":
+            acc.to_pandas().to_json(fname, orient="records", lines=True)
+        else:
+            raise ValueError(fmt)

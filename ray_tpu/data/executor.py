@@ -1,0 +1,374 @@
+"""Streaming executor: logical plan -> bounded-in-flight task pipeline.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:53 — a
+pull-based operator DAG with backpressure. Here each stage is a generator
+of (block_ref, meta) pairs; map stages keep at most
+DataContext.max_tasks_in_flight tasks outstanding (the backpressure), and
+all-to-all stages form a barrier (as in the reference's exchange planner,
+planner/exchange/).
+
+Blocks live in the shm object store between stages; metadata (row count /
+byte size) returns inline so the driver can plan limits/splits without
+fetching data.
+"""
+from __future__ import annotations
+
+import collections
+import random
+from typing import Any, Iterator, List, Optional, Tuple
+
+import ray_tpu as ray
+
+from .block import BlockAccessor, rows_to_block
+from .context import DataContext
+from .plan import AllToAll, InputBlocks, Limit, LogicalPlan, MapBlocks, Read, Union
+
+Meta = dict
+RefMeta = Tuple[Any, Meta]  # (ObjectRef -> Block, metadata)
+
+
+def _meta_of(block) -> Meta:
+    acc = BlockAccessor.for_block(block)
+    return {"num_rows": acc.num_rows(), "size_bytes": acc.size_bytes()}
+
+
+# --- remote task bodies -----------------------------------------------------
+def _run_read_task(read_task):
+    blocks = read_task()
+    out = []
+    for b in blocks:
+        out.append((ray.put(b), _meta_of(b)))
+    return out
+
+
+def _run_map_task(fn, block):
+    blocks = fn(block)
+    return [(ray.put(b), _meta_of(b)) for b in blocks]
+
+
+class _MapWorker:
+    """Actor for stateful (class) UDFs — reference: ActorPoolMapOperator."""
+
+    def __init__(self, cls, args):
+        self.udf = cls(*args)
+
+    def apply(self, fn, block):
+        blocks = fn(self.udf, block)
+        return [(ray.put(b), _meta_of(b)) for b in blocks]
+
+
+class StreamingExecutor:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+
+    # ------------------------------------------------------------------
+    def execute(self, plan: LogicalPlan) -> Iterator[RefMeta]:
+        stream: Iterator[RefMeta] = iter(())
+        for op in plan.optimized().ops:
+            if isinstance(op, Read):
+                stream = self._read_stage(op)
+            elif isinstance(op, InputBlocks):
+                stream = self._input_stage(op)
+            elif isinstance(op, MapBlocks):
+                if op.actor_cls is not None:
+                    stream = self._actor_map_stage(op, stream)
+                else:
+                    stream = self._map_stage(op, stream)
+            elif isinstance(op, Limit):
+                stream = self._limit_stage(op, stream)
+            elif isinstance(op, AllToAll):
+                stream = self._all_to_all_stage(op, stream)
+            elif isinstance(op, Union):
+                stream = self._union_stage(op, stream)
+            else:
+                raise TypeError(f"unknown logical op {op}")
+        return stream
+
+    # ------------------------------------------------------------------
+    def _input_stage(self, op: InputBlocks) -> Iterator[RefMeta]:
+        for entry in op.blocks:
+            if isinstance(entry, tuple):
+                yield entry
+            else:
+                yield (ray.put(entry), _meta_of(entry))
+
+    def _read_stage(self, op: Read) -> Iterator[RefMeta]:
+        remote_read = ray.remote(_run_read_task)
+        window = collections.deque()
+        for task in op.read_tasks:
+            window.append(remote_read.remote(task))
+            if len(window) >= self.ctx.max_tasks_in_flight:
+                yield from ray.get(window.popleft(), timeout=600)
+        while window:
+            yield from ray.get(window.popleft(), timeout=600)
+
+    def _map_stage(self, op: MapBlocks, upstream) -> Iterator[RefMeta]:
+        remote_map = ray.remote(_run_map_task)
+        window = collections.deque()
+        for ref, meta in upstream:
+            window.append(remote_map.remote(op.fn, ref))
+            if len(window) >= self.ctx.max_tasks_in_flight:
+                yield from ray.get(window.popleft(), timeout=600)
+        while window:
+            yield from ray.get(window.popleft(), timeout=600)
+
+    def _actor_map_stage(self, op: MapBlocks, upstream) -> Iterator[RefMeta]:
+        Worker = ray.remote(_MapWorker)
+        pool = [
+            Worker.remote(op.actor_cls, op.fn_args)
+            for _ in range(op.actor_pool_size)
+        ]
+        try:
+            window = collections.deque()
+            i = 0
+            for ref, meta in upstream:
+                actor = pool[i % len(pool)]
+                i += 1
+                window.append(actor.apply.remote(op.fn, ref))
+                if len(window) >= self.ctx.max_tasks_in_flight:
+                    yield from ray.get(window.popleft(), timeout=600)
+            while window:
+                yield from ray.get(window.popleft(), timeout=600)
+        finally:
+            for a in pool:
+                try:
+                    ray.kill(a)
+                except Exception:
+                    pass
+
+    def _limit_stage(self, op: Limit, upstream) -> Iterator[RefMeta]:
+        remaining = op.n
+        for ref, meta in upstream:
+            if remaining <= 0:
+                break
+            rows = meta["num_rows"]
+            if rows <= remaining:
+                remaining -= rows
+                yield ref, meta
+            else:
+                block = ray.get(ref, timeout=600)
+                cut = BlockAccessor.for_block(block).slice(0, remaining)
+                remaining = 0
+                yield ray.put(cut), _meta_of(cut)
+
+    def _union_stage(self, op: Union, upstream) -> Iterator[RefMeta]:
+        yield from upstream
+        for other in op.others:
+            yield from self.execute(other)
+
+    # ------------------------------------------------------------------
+    # all-to-all exchanges (barrier; reference: planner/exchange/)
+    # ------------------------------------------------------------------
+    def _all_to_all_stage(self, op: AllToAll, upstream) -> Iterator[RefMeta]:
+        inputs = list(upstream)
+        if op.kind == "repartition":
+            yield from self._repartition(inputs, op.params["num_blocks"])
+        elif op.kind == "random_shuffle":
+            yield from self._random_shuffle(inputs, op.params.get("seed"))
+        elif op.kind == "sort":
+            yield from self._sort(inputs, op.params["key"],
+                                  op.params.get("descending", False))
+        elif op.kind == "groupby":
+            yield from self._groupby(inputs, op.params)
+        else:
+            raise ValueError(f"unknown exchange {op.kind}")
+
+    def _repartition(self, inputs: List[RefMeta], n: int):
+        """Plan contiguous row segments into n equal outputs, then build
+        each output with one remote task (slice + combine)."""
+        total = sum(m["num_rows"] for _, m in inputs)
+        sizes = [total // n + (1 if i < total % n else 0) for i in range(n)]
+        assignments: List[List[Tuple[Any, int, int]]] = [[] for _ in range(n)]
+        out_i = 0
+        out_room = sizes[0] if n else 0
+        for ref, meta in inputs:
+            pos, rows = 0, meta["num_rows"]
+            while rows > 0:
+                while out_room == 0 and out_i < n - 1:
+                    out_i += 1
+                    out_room = sizes[out_i]
+                take = rows if out_i == n - 1 else min(rows, out_room)
+                assignments[out_i].append((ref, pos, pos + take))
+                pos += take
+                rows -= take
+                out_room -= take
+
+        def build_task(segments):
+            pieces = []
+            for ref, start, end in segments:
+                block = ray.get(ref, timeout=600)
+                pieces.append(
+                    BlockAccessor.for_block(block).slice(start, end)
+                )
+            merged = (
+                BlockAccessor.combine(pieces) if pieces else rows_to_block([])
+            )
+            return [(ray.put(merged), _meta_of(merged))]
+
+        remote_build = ray.remote(build_task)
+        outs = ray.get(
+            [remote_build.remote(seg) for seg in assignments], timeout=600
+        )
+        for out in outs:
+            yield from out
+
+    def _random_shuffle(self, inputs: List[RefMeta], seed):
+        n_out = max(1, len(inputs))
+
+        def shard_task(block, n, seed_i):
+            rng = random.Random(seed_i)
+            rows = list(BlockAccessor.for_block(block).iter_rows())
+            shards: List[List[Any]] = [[] for _ in range(n)]
+            for r in rows:
+                shards[rng.randrange(n)].append(r)
+            return [
+                (lambda b: (ray.put(b), _meta_of(b)))(rows_to_block(s))
+                for s in shards
+            ]
+
+        def reduce_task(seed_i, *shards):
+            rows = []
+            for s in shards:
+                rows.extend(BlockAccessor.for_block(s).iter_rows())
+            rng = random.Random(seed_i)
+            rng.shuffle(rows)
+            b = rows_to_block(rows)
+            return [(ray.put(b), _meta_of(b))]
+
+        remote_shard = ray.remote(shard_task)
+        remote_reduce = ray.remote(reduce_task)
+        shard_lists = ray.get(
+            [
+                remote_shard.remote(ref, n_out,
+                                    (seed or 0) * 1000 + i if seed is not None
+                                    else random.randrange(1 << 30))
+                for i, (ref, _) in enumerate(inputs)
+            ],
+            timeout=600,
+        )
+        for j in range(n_out):
+            shards_j = [sl[j][0] for sl in shard_lists]
+            yield from ray.get(
+                remote_reduce.remote(
+                    (seed or 0) * 7919 + j if seed is not None
+                    else random.randrange(1 << 30),
+                    *shards_j,
+                ),
+                timeout=600,
+            )
+
+    def _sort(self, inputs: List[RefMeta], key, descending: bool):
+        # sample boundaries -> range partition -> per-partition sort
+        # (reference: sort.py push-based exchange)
+        n_out = max(1, len(inputs))
+
+        def sample_task(block):
+            rows = list(BlockAccessor.for_block(block).iter_rows())
+            k = min(len(rows), 20)
+            return [r[key] if isinstance(r, dict) else r
+                    for r in random.sample(rows, k)] if rows else []
+
+        samples: List[Any] = []
+        for s in ray.get(
+            [ray.remote(sample_task).remote(ref) for ref, _ in inputs],
+            timeout=600,
+        ):
+            samples.extend(s)
+        samples.sort()
+        bounds = [
+            samples[int(len(samples) * (i + 1) / n_out)]
+            for i in range(n_out - 1)
+        ] if samples else []
+
+        def partition_task(block, bounds):
+            import bisect
+
+            shards: List[List[Any]] = [[] for _ in range(len(bounds) + 1)]
+            for r in BlockAccessor.for_block(block).iter_rows():
+                v = r[key] if isinstance(r, dict) else r
+                shards[bisect.bisect_left(bounds, v)].append(r)
+            return [
+                (lambda b: (ray.put(b), _meta_of(b)))(rows_to_block(s))
+                for s in shards
+            ]
+
+        def sort_task(*shards):
+            rows = []
+            for s in shards:
+                rows.extend(BlockAccessor.for_block(s).iter_rows())
+            rows.sort(
+                key=(lambda r: r[key] if isinstance(r, dict) else r),
+                reverse=descending,
+            )
+            b = rows_to_block(rows)
+            return [(ray.put(b), _meta_of(b))]
+
+        shard_lists = ray.get(
+            [
+                ray.remote(partition_task).remote(ref, bounds)
+                for ref, _ in inputs
+            ],
+            timeout=600,
+        )
+        part_range = range(n_out - 1, -1, -1) if descending else range(n_out)
+        for j in part_range:
+            shards_j = [sl[j][0] for sl in shard_lists]
+            yield from ray.get(
+                ray.remote(sort_task).remote(*shards_j), timeout=600
+            )
+
+    def _groupby(self, inputs: List[RefMeta], params):
+        key = params["key"]
+        aggs = params["aggs"]  # list of (name, col, fn) with fn in sum/count/min/max/mean
+        n_out = max(1, min(len(inputs), 8))
+
+        def shard_task(block, n):
+            import zlib
+
+            shards: List[List[Any]] = [[] for _ in range(n)]
+            for r in BlockAccessor.for_block(block).iter_rows():
+                # stable across processes (builtin hash() is salted per
+                # process for str/bytes — would split groups silently)
+                h = zlib.crc32(repr(r[key]).encode())
+                shards[h % n].append(r)
+            return [
+                (lambda b: (ray.put(b), _meta_of(b)))(rows_to_block(s))
+                for s in shards
+            ]
+
+        def agg_task(*shards):
+            groups: dict = {}
+            for s in shards:
+                for r in BlockAccessor.for_block(s).iter_rows():
+                    groups.setdefault(r[key], []).append(r)
+            out_rows = []
+            for gkey in sorted(groups, key=repr):
+                rows = groups[gkey]
+                row = {key: gkey}
+                for name, col, fn in aggs:
+                    if fn == "count":
+                        row[name] = len(rows)
+                    else:
+                        vals = [r[col] for r in rows]
+                        row[name] = {
+                            "sum": sum(vals),
+                            "min": min(vals),
+                            "max": max(vals),
+                            "mean": sum(vals) / len(vals),
+                        }[fn]
+                out_rows.append(row)
+            b = rows_to_block(out_rows)
+            return [(ray.put(b), _meta_of(b))]
+
+        shard_lists = ray.get(
+            [
+                ray.remote(shard_task).remote(ref, n_out)
+                for ref, _ in inputs
+            ],
+            timeout=600,
+        )
+        for j in range(n_out):
+            shards_j = [sl[j][0] for sl in shard_lists]
+            yield from ray.get(
+                ray.remote(agg_task).remote(*shards_j), timeout=600
+            )

@@ -1,0 +1,94 @@
+"""Logical plan + optimizer.
+
+Reference: python/ray/data/_internal/logical/ — operator DAG built lazily by
+Dataset methods, optimized by rules (fusion), then planned into physical
+operators. Here the same shape, compact: a linear chain of logical ops with
+map-fusion (the dominant rule in the reference's optimizer).
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, List, Optional, Tuple
+
+BlockTransform = Callable[[Any], List[Any]]  # block -> blocks
+
+
+@dataclass
+class LogicalOp:
+    name: str
+
+
+@dataclass
+class Read(LogicalOp):
+    read_tasks: List[Callable[[], List[Any]]]  # each returns block list
+
+
+@dataclass
+class InputBlocks(LogicalOp):
+    blocks: List[Any]  # materialized blocks or (ref, meta) pairs
+
+
+@dataclass
+class MapBlocks(LogicalOp):
+    fn: BlockTransform
+    # actor-pool compute when the UDF is a stateful class (reference:
+    # ActorPoolMapOperator); None = stateless tasks
+    actor_cls: Optional[Any] = None
+    actor_pool_size: int = 2
+    fn_args: tuple = ()
+
+
+@dataclass
+class AllToAll(LogicalOp):
+    kind: str  # repartition | random_shuffle | sort | groupby
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class Limit(LogicalOp):
+    n: int
+
+
+@dataclass
+class Union(LogicalOp):
+    others: List["LogicalPlan"]
+
+
+class LogicalPlan:
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def optimized(self) -> "LogicalPlan":
+        """Fuse consecutive stateless MapBlocks (reference: the
+        OperatorFusionRule — avoids materializing intermediate blocks)."""
+        out: List[LogicalOp] = []
+        for op in self.ops:
+            if (
+                out
+                and isinstance(op, MapBlocks)
+                and isinstance(out[-1], MapBlocks)
+                and op.actor_cls is None
+                and out[-1].actor_cls is None
+            ):
+                prev = out.pop()
+                f, g = prev.fn, op.fn
+
+                def fused(block, _f=f, _g=g):
+                    result = []
+                    for b in _f(block):
+                        result.extend(_g(b))
+                    return result
+
+                out.append(
+                    MapBlocks(name=f"{prev.name}->{op.name}", fn=fused)
+                )
+            else:
+                out.append(op)
+        return LogicalPlan(out)
+
+    def __repr__(self):
+        return " -> ".join(op.name for op in self.ops)

@@ -1,0 +1,174 @@
+"""ray_tpu.data tests (reference: python/ray/data/tests basic surface)."""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+def test_range_count_take(ray_start):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+
+def test_from_items_map_filter(ray_start):
+    ds = rd.from_items(list(range(20)))
+    out = (
+        ds.map(lambda r: {"v": r["item"] * 2})
+        .filter(lambda r: r["v"] % 4 == 0)
+        .take_all()
+    )
+    assert sorted(r["v"] for r in out) == [i * 2 for i in range(20)
+                                           if (i * 2) % 4 == 0]
+
+
+def test_map_batches_numpy(ray_start):
+    ds = rd.range(32)
+    out = ds.map_batches(
+        lambda batch: {"sq": batch["id"] ** 2}, batch_size=8
+    ).take_all()
+    assert sorted(r["sq"] for r in out) == [i * i for i in range(32)]
+
+
+def test_map_batches_fusion(ray_start):
+    ds = rd.range(16).map(lambda r: {"id": r["id"] + 1}).map(
+        lambda r: {"id": r["id"] * 10}
+    )
+    plan = ds._plan.optimized()
+    # two Map ops fused into one
+    assert len([op for op in plan.ops]) == 2
+    assert sorted(r["id"] for r in ds.take_all()) == [
+        (i + 1) * 10 for i in range(16)
+    ]
+
+
+def test_map_batches_class_udf_actor_pool(ray_start):
+    class AddOffset:
+        def __init__(self, off):
+            self.off = off
+
+        def __call__(self, batch):
+            return {"v": batch["id"] + self.off}
+
+    ds = rd.range(12).map_batches(
+        AddOffset, fn_constructor_args=(100,), concurrency=2, batch_size=4
+    )
+    assert sorted(r["v"] for r in ds.take_all()) == [
+        i + 100 for i in range(12)
+    ]
+
+
+def test_limit_and_flat_map(ray_start):
+    ds = rd.range(10).flat_map(lambda r: [r, r]).limit(7)
+    assert ds.count() == 7
+
+
+def test_repartition(ray_start):
+    ds = rd.range(50).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 50
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(50))
+
+
+def test_random_shuffle_preserves_rows(ray_start):
+    ds = rd.range(40).random_shuffle(seed=7)
+    rows = [r["id"] for r in ds.take_all()]
+    assert sorted(rows) == list(range(40))
+    assert rows != list(range(40))  # overwhelmingly likely shuffled
+
+
+def test_sort(ray_start):
+    items = [{"k": v} for v in [5, 3, 9, 1, 7, 2, 8, 0, 6, 4]]
+    ds = rd.from_items(items).sort("k")
+    assert [r["k"] for r in ds.take_all()] == list(range(10))
+    ds_desc = rd.from_items(items).sort("k", descending=True)
+    assert [r["k"] for r in ds_desc.take_all()] == list(range(9, -1, -1))
+
+
+def test_groupby_aggregates(ray_start):
+    items = [{"g": i % 3, "v": i} for i in range(12)]
+    out = rd.from_items(items).groupby("g").sum("v").take_all()
+    expect = {0: sum(range(0, 12, 3)), 1: sum(range(1, 12, 3)),
+              2: sum(range(2, 12, 3))}
+    assert {r["g"]: r["sum(v)"] for r in out} == expect
+    cnt = rd.from_items(items).groupby("g").count().take_all()
+    assert all(r["count()"] == 4 for r in cnt)
+
+
+def test_iter_batches_sizes(ray_start):
+    ds = rd.range(25)
+    batches = list(ds.iter_batches(batch_size=10, batch_format="numpy"))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [10, 10, 5]
+
+
+def test_split_for_train_ingest(ray_start):
+    shards = rd.range(30).split(3)
+    counts = [s.count() for s in shards]
+    assert sum(counts) == 30
+    assert all(c > 0 for c in counts)
+
+
+def test_parquet_roundtrip(ray_start, tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": range(10), "b": [f"s{i}" for i in range(10)]})
+    ds = rd.from_pandas(df)
+    out_dir = str(tmp_path / "pq")
+    ds.write_parquet(out_dir)
+    back = rd.read_parquet(out_dir)
+    assert back.count() == 10
+    rows = back.sort("a").take_all()
+    assert rows[0] == {"a": 0, "b": "s0"}
+
+
+def test_csv_roundtrip(ray_start, tmp_path):
+    ds = rd.from_items([{"x": i, "y": i * i} for i in range(5)])
+    out_dir = str(tmp_path / "csv")
+    ds.write_csv(out_dir)
+    back = rd.read_csv(out_dir)
+    assert back.count() == 5
+    assert {r["y"] for r in back.take_all()} == {0, 1, 4, 9, 16}
+
+
+def test_union(ray_start):
+    a = rd.range(5)
+    b = rd.range(3)
+    assert a.union(b).count() == 8
+
+
+def test_materialize_reuses_blocks(ray_start):
+    ds = rd.range(20).map(lambda r: {"id": r["id"] * 2}).materialize()
+    assert ds.count() == 20
+    assert ds.count() == 20  # second pass does not re-execute reads
+
+
+def test_groupby_string_keys_across_processes(ray_start):
+    """String keys must aggregate correctly despite per-process hash salt."""
+    items = [{"g": k, "v": 1} for k in ["a", "b", "c"] * 8]
+    out = rd.from_items(items).groupby("g").sum("v").take_all()
+    assert {r["g"]: r["sum(v)"] for r in out} == {"a": 8, "b": 8, "c": 8}
+    assert len(out) == 3  # no duplicate partial groups
+
+
+def test_map_batches_after_empty_filter(ray_start):
+    ds = rd.range(20).filter(lambda r: False).map_batches(
+        lambda b: {"v": b["id"] * 2}
+    )
+    assert ds.count() == 0
+
+
+def test_from_items_preserves_order(ray_start):
+    assert rd.from_items(list(range(20))).take(3) == [
+        {"item": 0}, {"item": 1}, {"item": 2}
+    ]
