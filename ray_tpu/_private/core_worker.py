@@ -330,6 +330,16 @@ class CoreWorker:
             EventLoopThread.get().run(self._server.stop(), 5.0)
         except Exception:
             pass
+        self._pool.close_all()
+        self.raylet.close_sync()
+        self.gcs.close()
+        try:
+            self.store.close()
+        except Exception:
+            pass
+        global _global_worker
+        if _global_worker is self:
+            _global_worker = None
 
     def _flush_pending_frees(self):
         """Synchronously delete remote shm copies of dead owned objects —
@@ -357,16 +367,6 @@ class CoreWorker:
                 cli.call_sync("delete_objects", object_ids=oids, timeout=3.0)
         except Exception:
             pass
-        self._pool.close_all()
-        self.raylet.close_sync()
-        self.gcs.close()
-        try:
-            self.store.close()
-        except Exception:
-            pass
-        global _global_worker
-        if _global_worker is self:
-            _global_worker = None
 
     def _register_handlers(self):
         s = self._server

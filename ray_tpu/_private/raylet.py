@@ -310,9 +310,11 @@ class Raylet:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         if not tpu:
             # CPU worker: disable the TPU runtime hook (faster startup; the
-            # chip stays claimable by TPU workers / the driver).
+            # chip stays claimable by TPU workers / the driver). JAX_PLATFORMS
+            # must be overridden: an inherited 'axon'/'tpu' value would point
+            # jax at the backend we just disabled.
             env["PALLAS_AXON_POOL_IPS"] = ""
-            env.setdefault("JAX_PLATFORMS", "cpu")
+            env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.Popen(
             [
                 sys.executable,

@@ -1,0 +1,11 @@
+"""ray_tpu.llm — native LLM inference: continuous batching on TPU.
+
+Reference: python/ray/llm/ (SURVEY §2.4) — but where the reference wraps
+vLLM (llm/_internal/serve/deployments/llm/vllm/), the engine here is
+native jax: a slot-based continuous-batching scheduler around a jitted
+KV-cache decode step (models/llama.py forward_cached), bucketed prefill
+compiles, and OpenAI-style serving through ray_tpu.serve.
+"""
+from .engine import EngineConfig, GenerationResult, LLMEngine, SamplingParams  # noqa: F401
+from .serving import LLMServer, build_openai_app  # noqa: F401
+from .batch import batch_generate  # noqa: F401

@@ -1,0 +1,51 @@
+"""Batch inference over ray_tpu.data (reference: ray.data.llm
+build_llm_processor, data/llm.py:248)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class _EngineUDF:
+    """Stateful map_batches UDF hosting one engine per actor."""
+
+    def __init__(self, model_config: Optional[dict],
+                 engine_config: Optional[dict], sampling: Optional[dict]):
+        from ..models.llama import LlamaConfig
+        from .engine import EngineConfig, LLMEngine, SamplingParams
+
+        model_config = dict(model_config or {})
+        preset = model_config.pop("preset", "tiny")
+        cfg = getattr(LlamaConfig, preset)(**model_config)
+        self.engine = LLMEngine(
+            cfg, engine_config=EngineConfig(**(engine_config or {}))
+        )
+        self.params = SamplingParams(**(sampling or {}))
+
+    def __call__(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        prompts = [list(map(int, p)) for p in batch["prompt"]]
+        results = self.engine.generate_batch(prompts, self.params)
+        return {
+            "prompt": [list(p) for p in prompts],
+            "generated": [r.token_ids for r in results],
+            "finish_reason": [r.finish_reason for r in results],
+        }
+
+
+def batch_generate(
+    ds,
+    *,
+    model_config: Optional[dict] = None,
+    engine_config: Optional[dict] = None,
+    sampling: Optional[dict] = None,
+    concurrency: int = 1,
+    batch_size: int = 8,
+):
+    """ds rows must have a 'prompt' column of token-id lists. Returns a
+    Dataset with 'generated' + 'finish_reason' columns."""
+    return ds.map_batches(
+        _EngineUDF,
+        fn_constructor_args=(model_config, engine_config, sampling),
+        concurrency=concurrency,
+        batch_size=batch_size,
+        batch_format="numpy",
+    )

@@ -1,0 +1,308 @@
+"""Continuous-batching LLM engine.
+
+Reference shape: vLLM's engine as wrapped by ray.llm
+(vllm_engine.py) — here rebuilt TPU-first:
+  - fixed slot-array KV cache [L, B, S, Hkv, D]: static shapes so the
+    decode step compiles ONCE and streams batches (the compiled-graph
+    lesson: keep one XLA program alive, SURVEY §2.3 aDAG row);
+  - prefill compiled per power-of-two prompt bucket, single-slot, row
+    scattered into the shared cache;
+  - the scheduler admits waiting requests into free slots each iteration,
+    decodes all active slots in ONE batched step, retires finished ones
+    (continuous batching, per-iteration scheduling).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0
+    stop_token_ids: tuple = ()
+    seed: Optional[int] = None
+
+
+@dataclass
+class EngineConfig:
+    max_batch_size: int = 8
+    max_seq_len: int = 1024
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
+
+
+@dataclass
+class GenerationResult:
+    request_id: int
+    prompt_tokens: List[int]
+    token_ids: List[int]
+    finish_reason: str
+    ttft_s: float = 0.0
+    latency_s: float = 0.0
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "params", "generated", "event", "result",
+                 "submit_time", "first_token_time")
+
+    def __init__(self, rid, prompt, params):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.params = params
+        self.generated: List[int] = []
+        self.event = threading.Event()
+        self.result: Optional[GenerationResult] = None
+        self.submit_time = time.time()
+        self.first_token_time: Optional[float] = None
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        model_config,
+        params: Optional[Any] = None,
+        engine_config: Optional[EngineConfig] = None,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.llama import forward_cached, init_cache, init_params
+
+        self._jax = jax
+        self._jnp = jnp
+        self.cfg = model_config
+        self.ecfg = engine_config or EngineConfig()
+        if self.ecfg.max_seq_len > model_config.max_seq_len:
+            self.ecfg.max_seq_len = model_config.max_seq_len
+        self.params = (
+            params
+            if params is not None
+            else init_params(model_config, jax.random.PRNGKey(seed))
+        )
+        B, S = self.ecfg.max_batch_size, self.ecfg.max_seq_len
+        self.cache = init_cache(model_config, B, S)
+        self.lengths = np.zeros(B, dtype=np.int32)
+        self.slots: List[Optional[_Request]] = [None] * B
+        self._rng = np.random.default_rng(seed)
+
+        cfg = model_config
+
+        # compile once: batched single-token decode
+        def decode_step(params, cache, tokens, lengths):
+            logits, cache = forward_cached(cfg, params, tokens, cache,
+                                           lengths)
+            return logits[:, -1, :], cache
+
+        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+
+        # prefill per bucket, single slot
+        def prefill(params, cache1, tokens, true_len):
+            zero = jnp.zeros((1,), dtype=jnp.int32)
+            logits, cache1 = forward_cached(cfg, params, tokens, cache1,
+                                            zero)
+            last = logits[0, true_len - 1, :]
+            return last, cache1
+
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._next_rid = 0
+        self._rid_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def generate_async(self, prompt_tokens: List[int],
+                       params: Optional[SamplingParams] = None) -> _Request:
+        with self._rid_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = _Request(rid, prompt_tokens, params or SamplingParams())
+        if len(req.prompt) >= self.ecfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} >= max_seq_len "
+                f"{self.ecfg.max_seq_len}"
+            )
+        self._queue.put(req)
+        return req
+
+    def generate(self, prompt_tokens: List[int],
+                 params: Optional[SamplingParams] = None,
+                 timeout: float = 300.0) -> GenerationResult:
+        req = self.generate_async(prompt_tokens, params)
+        if not req.event.wait(timeout):
+            raise TimeoutError(f"generation {req.rid} timed out")
+        return req.result
+
+    def generate_batch(self, prompts: List[List[int]],
+                       params: Optional[SamplingParams] = None,
+                       timeout: float = 600.0) -> List[GenerationResult]:
+        reqs = [self.generate_async(p, params) for p in prompts]
+        out = []
+        deadline = time.time() + timeout
+        for r in reqs:
+            if not r.event.wait(max(0.0, deadline - time.time())):
+                raise TimeoutError("batch generation timed out")
+            out.append(r.result)
+        return out
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "active": sum(s is not None for s in self.slots),
+            "waiting": self._queue.qsize(),
+            "max_batch": self.ecfg.max_batch_size,
+        }
+
+    # ------------------------------------------------------------------
+    # scheduler loop
+    # ------------------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self.ecfg.prefill_buckets:
+            if n <= b and b <= self.ecfg.max_seq_len:
+                return b
+        return self.ecfg.max_seq_len
+
+    def _loop(self):
+        jnp = self._jnp
+        while not self._stop.is_set():
+            try:
+                self._loop_once(jnp)
+            except Exception:  # noqa: BLE001 — scheduler must survive
+                import traceback
+
+                err = traceback.format_exc()
+                for i, req in enumerate(self.slots):
+                    if req is not None:
+                        self._finish_with_error(i, err)
+                time.sleep(0.05)
+
+    def _finish_with_error(self, i: int, err: str):
+        req = self.slots[i]
+        req.result = GenerationResult(
+            request_id=req.rid,
+            prompt_tokens=req.prompt,
+            token_ids=list(req.generated),
+            finish_reason=f"error: {err.splitlines()[-1][:200]}",
+            latency_s=time.time() - req.submit_time,
+        )
+        self.slots[i] = None
+        self.lengths[i] = 0
+        req.event.set()
+
+    def _loop_once(self, jnp):
+            admitted = self._admit()
+            active = [i for i, s in enumerate(self.slots) if s is not None]
+            if not active:
+                if not admitted:
+                    time.sleep(0.002)
+                return
+            # one batched decode step for every active slot
+            last_tokens = np.zeros(
+                (self.ecfg.max_batch_size, 1), dtype=np.int32
+            )
+            for i in active:
+                req = self.slots[i]
+                last_tokens[i, 0] = (
+                    req.generated[-1] if req.generated else req.prompt[-1]
+                )
+            logits, self.cache = self._decode(
+                self.params,
+                self.cache,
+                jnp.asarray(last_tokens),
+                jnp.asarray(self.lengths),
+            )
+            logits_np = np.asarray(logits)
+            self.lengths[active] += 1
+            now = time.time()
+            for i in active:
+                req = self.slots[i]
+                tok = self._sample(logits_np[i], req.params)
+                req.generated.append(int(tok))
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                self._maybe_finish(i)
+
+    def _admit(self) -> bool:
+        jnp = self._jnp
+        admitted = False
+        for i in range(self.ecfg.max_batch_size):
+            if self.slots[i] is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            bucket = self._bucket(len(req.prompt))
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, : len(req.prompt)] = req.prompt
+            from ..models.llama import init_cache
+
+            cache1 = init_cache(self.cfg, 1, self.ecfg.max_seq_len)
+            last_logits, cache1 = self._prefill(
+                self.params, cache1, jnp.asarray(tokens),
+                np.int32(len(req.prompt)),
+            )
+            # scatter the prefilled row into the shared cache at slot i
+            self.cache = {
+                "k": self.cache["k"].at[:, i].set(cache1["k"][:, 0]),
+                "v": self.cache["v"].at[:, i].set(cache1["v"][:, 0]),
+            }
+            self.lengths[i] = len(req.prompt)
+            tok = self._sample(np.asarray(last_logits), req.params)
+            req.generated.append(int(tok))
+            req.first_token_time = time.time()
+            self.slots[i] = req
+            admitted = True
+            self._maybe_finish(i)
+        return admitted
+
+    def _sample(self, logits: np.ndarray, params: SamplingParams) -> int:
+        if params.temperature <= 0.0:
+            return int(np.argmax(logits))
+        logits = logits / params.temperature
+        if params.top_k and params.top_k > 0:
+            kth = np.partition(logits, -params.top_k)[-params.top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        logits = logits - logits.max()
+        p = np.exp(logits)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _maybe_finish(self, i: int):
+        req = self.slots[i]
+        reason = None
+        if req.generated and req.generated[-1] in req.params.stop_token_ids:
+            reason = "stop"
+        elif len(req.generated) >= req.params.max_tokens:
+            reason = "length"
+        elif self.lengths[i] + 1 >= self.ecfg.max_seq_len:
+            reason = "max_seq_len"
+        if reason is None:
+            return
+        now = time.time()
+        req.result = GenerationResult(
+            request_id=req.rid,
+            prompt_tokens=req.prompt,
+            token_ids=list(req.generated),
+            finish_reason=reason,
+            ttft_s=(req.first_token_time or now) - req.submit_time,
+            latency_s=now - req.submit_time,
+        )
+        self.slots[i] = None
+        self.lengths[i] = 0
+        req.event.set()

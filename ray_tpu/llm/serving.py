@@ -1,0 +1,146 @@
+"""LLM serving: OpenAI-style deployment on ray_tpu.serve.
+
+Reference: ray.serve.llm — LLMServer deployment wrapping the engine
+(llm/_internal/serve/deployments/llm/llm_server.py) + OpenAI-compatible
+API (configs/openai_api_models.py). Completions/chat payloads map onto the
+native engine; prompts are token-id lists, or strings when a HF tokenizer
+name is configured (transformers is available in-image).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+class LLMServer:
+    """User-facing deployment class (wrap with serve.deployment)."""
+
+    def __init__(self, model_config: Optional[dict] = None,
+                 engine_config: Optional[dict] = None,
+                 tokenizer: Optional[str] = None,
+                 params_checkpoint: Optional[str] = None):
+        from ..models.llama import LlamaConfig
+        from .engine import EngineConfig, LLMEngine
+
+        model_config = model_config or {}
+        preset = model_config.pop("preset", "tiny")
+        factory = getattr(LlamaConfig, preset)
+        cfg = factory(**model_config)
+        params = None
+        if params_checkpoint:
+            from ..train.checkpoint import Checkpoint
+
+            params = Checkpoint(params_checkpoint).load_state()
+        self.engine = LLMEngine(
+            cfg,
+            params=params,
+            engine_config=EngineConfig(**(engine_config or {})),
+        )
+        self.tokenizer = None
+        if tokenizer:
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(tokenizer)
+
+    def _encode(self, prompt) -> List[int]:
+        if isinstance(prompt, list):
+            return [int(t) for t in prompt]
+        if self.tokenizer is None:
+            raise ValueError(
+                "string prompts require a tokenizer; pass token-id lists"
+            )
+        return self.tokenizer.encode(prompt)
+
+    def _decode_text(self, token_ids: List[int]) -> Optional[str]:
+        if self.tokenizer is None:
+            return None
+        return self.tokenizer.decode(token_ids)
+
+    async def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI-ish: supports /v1/completions-shaped payloads and chat
+        messages (flattened)."""
+        from .engine import SamplingParams
+
+        if "messages" in payload:  # chat
+            if self.tokenizer is not None and hasattr(
+                self.tokenizer, "apply_chat_template"
+            ):
+                prompt = self.tokenizer.apply_chat_template(
+                    payload["messages"], tokenize=True
+                )
+            else:
+                prompt = []
+                for m in payload["messages"]:
+                    prompt.extend(self._encode(m["content"]))
+        else:
+            prompt = self._encode(payload.get("prompt", []))
+        params = SamplingParams(
+            max_tokens=int(payload.get("max_tokens", 64)),
+            temperature=float(payload.get("temperature", 0.0)),
+            top_k=int(payload.get("top_k", 0)),
+            stop_token_ids=tuple(payload.get("stop_token_ids", ())),
+        )
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        result = await loop.run_in_executor(
+            None, lambda: self.engine.generate(prompt, params)
+        )
+        text = self._decode_text(result.token_ids)
+        choice: Dict[str, Any] = {
+            "index": 0,
+            "token_ids": result.token_ids,
+            "finish_reason": result.finish_reason,
+        }
+        if text is not None:
+            choice["text"] = text
+        return {
+            "id": f"cmpl-{result.request_id}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "choices": [choice],
+            "usage": {
+                "prompt_tokens": len(prompt),
+                "completion_tokens": len(result.token_ids),
+                "total_tokens": len(prompt) + len(result.token_ids),
+            },
+            "metrics": {
+                "ttft_s": result.ttft_s,
+                "latency_s": result.latency_s,
+            },
+        }
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+def build_openai_app(
+    model_config: Optional[dict] = None,
+    engine_config: Optional[dict] = None,
+    tokenizer: Optional[str] = None,
+    *,
+    num_replicas: int = 1,
+    route_prefix: str = "/v1",
+    ray_actor_options: Optional[dict] = None,
+):
+    """Returns a serve Application exposing /v1/completions-style HTTP."""
+    from .. import serve
+
+    if ray_actor_options is None and _tpu_visible():
+        # one TPU chip per replica (process-exclusive on TPU VMs)
+        ray_actor_options = {"num_tpus": 1}
+    dep = serve.deployment(
+        LLMServer,
+        name="LLMServer",
+        num_replicas=num_replicas,
+        route_prefix=route_prefix,
+        max_ongoing_requests=256,
+        ray_actor_options=ray_actor_options,
+    )
+    return dep.bind(model_config, engine_config, tokenizer)
+
+
+def _tpu_visible() -> bool:
+    import os
+
+    return bool(os.environ.get("TPU_CHIPS"))

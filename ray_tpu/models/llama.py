@@ -250,6 +250,94 @@ def forward(
     return (x.astype(jnp.float32) @ head.astype(jnp.float32))
 
 
+# ---------------------------------------------------------------------------
+# KV-cache inference path (serving; reference delegates this to vLLM —
+# here it is native: SURVEY §2.4 Ray LLM row)
+# ---------------------------------------------------------------------------
+def init_cache(cfg: LlamaConfig, batch_size: int, max_seq: int
+               ) -> Dict[str, jax.Array]:
+    shape = (cfg.n_layers, batch_size, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def _cached_attention(q, k_cache, v_cache, positions, scale):
+    """q: [B,T,H,D]; caches: [B,S,Hkv,D]; positions: [B,T] global q pos.
+    Attends to kv_pos <= q_pos (cache rows beyond each row's filled length
+    hold zeros but are masked out)."""
+    B, T, H, D = q.shape
+    S = k_cache.shape[1]
+    Hkv = k_cache.shape[2]
+    if Hkv != H:
+        rep = H // Hkv
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    ) * scale
+    kv_pos = jnp.arange(S)[None, None, None, :]  # [1,1,1,S]
+    mask = kv_pos <= positions[:, None, :, None]  # [B,1,T,S]
+    scores = jnp.where(mask, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhts,bshd->bthd", p, v_cache.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
+def forward_cached(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,  # [B, T] new tokens for each slot
+    cache: Dict[str, jax.Array],
+    start_pos: jax.Array,  # [B] current filled length per slot
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Incremental forward: writes K/V for the new tokens into the cache,
+    returns (logits [B, T, vocab], updated cache). Prefill: T = prompt
+    length; decode: T = 1. jit-stable for fixed (B, T)."""
+    B, T = tokens.shape
+    hd = cfg.head_dim
+    x = params["tok_embed"][tokens]
+    positions = start_pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    scale = hd ** -0.5
+
+    def write_rows(cache_l, new):
+        # per-row dynamic update at row-specific offsets
+        def upd(c_b, n_b, p_b):
+            return jax.lax.dynamic_update_slice_in_dim(c_b, n_b, p_b, axis=0)
+
+        return jax.vmap(upd)(cache_l, new, start_pos)
+
+    def layer(x, scanned):
+        lp, k_cache_l, v_cache_l = scanned
+        h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, T, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, T, cfg.n_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache_l = write_rows(k_cache_l, k.astype(k_cache_l.dtype))
+        v_cache_l = write_rows(v_cache_l, v.astype(v_cache_l.dtype))
+        attn = _cached_attention(q, k_cache_l, v_cache_l, positions, scale)
+        x = x + attn.reshape(B, T, cfg.n_heads * hd) @ lp["wo"]
+        h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+        return x, (k_cache_l, v_cache_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (
+        params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    )
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
 def loss_fn(
     cfg: LlamaConfig,
     params: Dict[str, Any],

@@ -1,0 +1,21 @@
+"""ray_tpu.serve — scalable model serving on the actor runtime.
+
+Reference: python/ray/serve/ (SURVEY §2.4, §3.4): a ServeController actor
+reconciles deployment configs into replica actors; an HTTP proxy routes
+requests through a power-of-two-choices router; deployment handles give
+Python-level RPC with the same routing; autoscaling reacts to in-flight
+request load; @serve.batch coalesces requests for the accelerator.
+"""
+from .api import (  # noqa: F401
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from .batching import batch  # noqa: F401
+from .handle import DeploymentHandle  # noqa: F401

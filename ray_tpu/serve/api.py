@@ -1,0 +1,213 @@
+"""serve public API: @deployment, run, shutdown, handles.
+
+Reference: serve/api.py:665 (serve.run), serve/deployment.py
+(@serve.deployment + Deployment.bind -> Application).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+from .controller import CONTROLLER_NAME, ServeController
+from .handle import DeploymentHandle
+
+_PROXY_NAME = "SERVE_PROXY"
+
+
+class Application:
+    def __init__(self, deployment: "Deployment", args: tuple, kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls: type, name: Optional[str] = None, **options):
+        self._cls = cls
+        self.name = name or cls.__name__
+        self.options_dict = options
+
+    def options(self, **overrides) -> "Deployment":
+        merged = {**self.options_dict, **overrides}
+        name = merged.pop("name", self.name)
+        return Deployment(self._cls, name=name, **merged)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"deployment {self.name} cannot be called directly; deploy it "
+            f"with serve.run(…)"
+        )
+
+
+def deployment(_cls=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_ongoing_requests: int = 100,
+               autoscaling_config: Optional[dict] = None,
+               ray_actor_options: Optional[dict] = None,
+               route_prefix: Optional[str] = None,
+               user_config: Any = None):
+    def decorator(cls):
+        return Deployment(
+            cls,
+            name=name,
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=ray_actor_options,
+            route_prefix=route_prefix,
+            user_config=user_config,
+        )
+
+    if _cls is not None:
+        return decorator(_cls)
+    return decorator
+
+
+# ---------------------------------------------------------------------------
+def _get_or_create_controller():
+    import ray_tpu as ray
+
+    try:
+        return ray.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        Controller = ray.remote(ServeController)
+        handle = Controller.options(
+            name=CONTROLLER_NAME, lifetime="detached", max_restarts=1,
+            max_concurrency=8,
+        ).remote()
+        ray.get(handle.ping.remote(), timeout=60)
+        return handle
+
+
+def _get_or_create_proxy(http_host: str, http_port: int):
+    import ray_tpu as ray
+
+    from .proxy import ProxyActor
+
+    try:
+        return ray.get_actor(_PROXY_NAME)
+    except ValueError:
+        Proxy = ray.remote(ProxyActor)
+        handle = Proxy.options(
+            name=_PROXY_NAME, lifetime="detached", max_concurrency=64,
+        ).remote(http_host, http_port)
+        ray.get(handle.address.remote(), timeout=60)
+        return handle
+
+
+def run(
+    target: Application | Deployment,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    http_host: str = "127.0.0.1",
+    http_port: int = 8000,
+    blocking: bool = False,
+    _http: bool = True,
+) -> DeploymentHandle:
+    """Deploy an application; returns the ingress deployment handle."""
+    import ray_tpu as ray
+
+    if isinstance(target, Deployment):
+        target = target.bind()
+    dep = target.deployment
+    opts = dep.options_dict
+    controller = _get_or_create_controller()
+    config = {
+        "serialized_cls": cloudpickle.dumps(dep._cls),
+        "init_args": cloudpickle.dumps(
+            (target.init_args, target.init_kwargs)
+        ),
+        "num_replicas": opts.get("num_replicas", 1),
+        "max_ongoing_requests": opts.get("max_ongoing_requests", 100),
+        "autoscaling_config": opts.get("autoscaling_config"),
+        "ray_actor_options": opts.get("ray_actor_options"),
+        "route_prefix": opts.get("route_prefix") or route_prefix,
+        "app_name": name,
+    }
+    ray.get(controller.deploy.remote(name=dep.name, config=config),
+            timeout=60)
+
+    # wait for at least one replica
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        replicas = ray.get(
+            controller.get_replicas.remote(name=dep.name), timeout=30
+        )
+        if replicas:
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError(f"deployment {dep.name} has no replicas")
+
+    if _http:
+        proxy = _get_or_create_proxy(http_host, http_port)
+        routes = {}
+        deps = ray.get(controller.get_deployments.remote(), timeout=30)
+        for dname, cfg in deps.items():
+            prefix = cfg.get("route_prefix")
+            if prefix:
+                routes[prefix] = dname
+        ray.get(proxy.update_routes.remote(routes=routes), timeout=30)
+
+    handle = DeploymentHandle(dep.name)
+    if blocking:  # pragma: no cover
+        while True:
+            time.sleep(1)
+    return handle
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu as ray
+
+    controller = ray.get_actor(CONTROLLER_NAME)
+    deps = ray.get(controller.get_deployments.remote(), timeout=30)
+    for dname, cfg in deps.items():
+        if cfg.get("app_name") == name:
+            return DeploymentHandle(dname)
+    raise ValueError(f"no application named {name!r}")
+
+
+def delete(deployment_name: str):
+    """Remove a deployment and its replicas (reference: serve.delete)."""
+    import ray_tpu as ray
+
+    try:
+        controller = ray.get_actor(CONTROLLER_NAME)
+        ray.get(controller.delete_deployment.remote(name=deployment_name),
+                timeout=30)
+    except ValueError:
+        pass
+
+
+def status() -> dict:
+    import ray_tpu as ray
+
+    controller = ray.get_actor(CONTROLLER_NAME)
+    return ray.get(controller.get_status.remote(), timeout=30)
+
+
+def shutdown():
+    import ray_tpu as ray
+
+    try:
+        controller = ray.get_actor(CONTROLLER_NAME)
+        ray.get(controller.graceful_shutdown.remote(), timeout=30)
+        ray.kill(controller)
+    except Exception:
+        pass
+    try:
+        proxy = ray.get_actor(_PROXY_NAME)
+        ray.kill(proxy)
+    except Exception:
+        pass

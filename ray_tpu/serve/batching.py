@@ -1,0 +1,92 @@
+"""@serve.batch — dynamic request batching.
+
+Reference: python/ray/serve/batching.py — decorate an async method taking a
+list of inputs; concurrent callers are coalesced up to max_batch_size or
+batch_wait_timeout_s, then the method runs once per batch and each caller
+gets its element back. The TPU sweet spot: batch to fill the MXU.
+"""
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.queue: List[tuple] = []  # (item, future)
+        self._flush_task: Optional[asyncio.Task] = None
+        self._lock = asyncio.Lock()
+
+    async def submit(self, instance, item):
+        fut = asyncio.get_running_loop().create_future()
+        async with self._lock:
+            self.queue.append((item, fut))
+            if len(self.queue) >= self.max_batch_size:
+                batch = self.queue
+                self.queue = []
+                asyncio.ensure_future(self._run(instance, batch))
+            elif self._flush_task is None or self._flush_task.done():
+                self._flush_task = asyncio.ensure_future(
+                    self._flush_later(instance)
+                )
+        return await fut
+
+    async def _flush_later(self, instance):
+        await asyncio.sleep(self.timeout_s)
+        async with self._lock:
+            batch, self.queue = self.queue, []
+        if batch:
+            await self._run(instance, batch)
+
+    async def _run(self, instance, batch):
+        items = [b[0] for b in batch]
+        try:
+            if instance is not None:
+                results = await self.fn(instance, items)
+            else:
+                results = await self.fn(items)
+            if len(results) != len(items):
+                raise ValueError(
+                    f"batched fn returned {len(results)} results for "
+                    f"{len(items)} inputs"
+                )
+            for (_, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:  # noqa: BLE001
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    def decorator(fn):
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async def method")
+        queues: dict = {}  # per-instance queue
+
+        @functools.wraps(fn)
+        async def wrapper(self_or_item, item=None):
+            if item is None:  # plain function
+                instance, payload = None, self_or_item
+                key = id(fn)
+            else:  # bound method
+                instance, payload = self_or_item, item
+                key = id(instance)
+            q = queues.get(key)
+            if q is None:
+                q = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+                queues[key] = q
+            return await q.submit(instance, payload)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

@@ -1,0 +1,176 @@
+"""ServeController: the reconciliation control loop.
+
+Reference: serve/_private/controller.py:90 + deployment_state.py:1391,2500
+— desired deployment configs vs. running replica actors, reconciled
+continuously; autoscaling decisions from replica in-flight stats
+(autoscaling_state.py:261, serve/autoscaling_policy.py:12).
+
+Runs as a detached named actor ("SERVE_CONTROLLER") so `serve.run` from a
+new driver finds the running system.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    def __init__(self):
+        # name -> config dict (serialized class, args, num_replicas, ...)
+        self._configs: Dict[str, dict] = {}
+        # name -> list of {"actor_id", "handle", "healthy"}
+        self._replicas: Dict[str, List[dict]] = {}
+        self._version = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._control_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def deploy(self, name: str, config: dict) -> bool:
+        self._configs[name] = config
+        self._version += 1
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        self._configs.pop(name, None)
+        self._version += 1
+        return True
+
+    def get_deployments(self) -> Dict[str, dict]:
+        return {
+            name: {k: v for k, v in cfg.items()
+                   if k not in ("serialized_cls", "init_args")}
+            for name, cfg in self._configs.items()
+        }
+
+    def get_replicas(self, name: str) -> List[str]:
+        """Actor ids of healthy replicas (the router's routing table)."""
+        return [
+            r["actor_id"] for r in self._replicas.get(name, [])
+            if r.get("healthy", True)
+        ]
+
+    def get_status(self) -> dict:
+        return {
+            "deployments": {
+                name: {
+                    "num_replicas": len(self._replicas.get(name, [])),
+                    "target": self._target_replicas(name),
+                    "route_prefix": cfg.get("route_prefix"),
+                }
+                for name, cfg in self._configs.items()
+            },
+            "version": self._version,
+        }
+
+    def ping(self) -> str:
+        return "pong"
+
+    def graceful_shutdown(self) -> bool:
+        self._stop.set()
+        import ray_tpu as ray
+
+        for name in list(self._replicas):
+            for rep in self._replicas[name]:
+                try:
+                    ray.kill(rep["handle"])
+                except Exception:
+                    pass
+        self._replicas.clear()
+        return True
+
+    # ------------------------------------------------------------------
+    def _target_replicas(self, name: str) -> int:
+        cfg = self._configs.get(name)
+        if cfg is None:
+            return 0
+        auto = cfg.get("autoscaling_config")
+        if not auto:
+            return cfg.get("num_replicas", 1)
+        current = self._replicas.get(name, [])
+        if not current:
+            return max(1, auto.get("min_replicas", 1))
+        # scale on mean ongoing requests per replica (reference policy)
+        import ray_tpu as ray
+
+        stats = []
+        for rep in current:
+            try:
+                stats.append(
+                    ray.get(rep["handle"].get_stats.remote(), timeout=5)
+                )
+            except Exception:
+                pass
+        if not stats:
+            return len(current)
+        mean_ongoing = sum(s["ongoing"] for s in stats) / len(stats)
+        target = auto.get("target_ongoing_requests", 2)
+        desired = len(current)
+        if mean_ongoing > target:
+            desired = len(current) + 1
+        elif mean_ongoing < target / 2 and len(current) > 1:
+            desired = len(current) - 1
+        return max(
+            auto.get("min_replicas", 1),
+            min(auto.get("max_replicas", 10), desired),
+        )
+
+    def _control_loop(self):
+        import ray_tpu as ray
+
+        while not self._stop.is_set():
+            try:
+                self._reconcile(ray)
+            except Exception:
+                pass
+            time.sleep(0.5)
+
+    def _reconcile(self, ray):
+        from .replica import ReplicaActor
+
+        # remove replicas of deleted deployments
+        for name in list(self._replicas):
+            if name not in self._configs:
+                for rep in self._replicas.pop(name):
+                    try:
+                        ray.kill(rep["handle"])
+                    except Exception:
+                        pass
+
+        for name, cfg in list(self._configs.items()):
+            replicas = self._replicas.setdefault(name, [])
+            # drop dead replicas (actor died / unreachable)
+            alive = []
+            for rep in replicas:
+                try:
+                    ray.get(rep["handle"].check_health.remote(), timeout=10)
+                    alive.append(rep)
+                except Exception:
+                    pass
+            replicas[:] = alive
+            target = self._target_replicas(name)
+            while len(replicas) < target:
+                Replica = ray.remote(ReplicaActor)
+                opts = dict(cfg.get("ray_actor_options") or {})
+                opts["max_concurrency"] = max(
+                    2, cfg.get("max_ongoing_requests", 100)
+                )
+                handle = Replica.options(**opts).remote(
+                    cfg["serialized_cls"],
+                    cfg["init_args"],
+                    cfg.get("max_ongoing_requests", 100),
+                )
+                replicas.append(
+                    {"actor_id": handle.actor_id, "handle": handle,
+                     "healthy": True}
+                )
+            while len(replicas) > target:
+                rep = replicas.pop()
+                try:
+                    ray.kill(rep["handle"])
+                except Exception:
+                    pass

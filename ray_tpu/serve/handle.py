@@ -1,0 +1,135 @@
+"""DeploymentHandle + power-of-two-choices router.
+
+Reference: serve/handle.py:715 (DeploymentHandle.remote) →
+_private/router.py:381 → request_router/pow_2_router.py:27 — pick the
+less-loaded of two random replicas by in-flight count.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _Router:
+    """Routing table + local in-flight accounting for pow-2 choice."""
+
+    def __init__(self, deployment_name: str, controller_handle):
+        self.name = deployment_name
+        self.controller = controller_handle
+        self._replicas: List[Any] = []  # ActorHandle list
+        self._inflight: Dict[str, int] = {}
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    def _refresh(self, force: bool = False):
+        import ray_tpu as ray
+
+        from ..actor import ActorHandle
+        from .replica import ReplicaActor
+        from ..actor import _public_methods
+
+        now = time.monotonic()
+        if not force and now - self._last_refresh < 2.0 and self._replicas:
+            return
+        actor_ids = ray.get(
+            self.controller.get_replicas.remote(name=self.name), timeout=30
+        )
+        methods = _public_methods(ReplicaActor)
+        with self._lock:
+            self._replicas = [ActorHandle(aid, methods) for aid in actor_ids]
+            self._inflight = {
+                aid: self._inflight.get(aid, 0) for aid in actor_ids
+            }
+            self._last_refresh = now
+
+    def choose(self):
+        """Power-of-two-choices by locally tracked in-flight count."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                reps = list(self._replicas)
+            if reps:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas available for deployment {self.name!r}"
+                )
+            time.sleep(0.1)
+            self._last_refresh = 0.0
+        if len(reps) == 1:
+            return reps[0]
+        a, b = random.sample(reps, 2)
+        with self._lock:
+            ia = self._inflight.get(a.actor_id, 0)
+            ib = self._inflight.get(b.actor_id, 0)
+        return a if ia <= ib else b
+
+    def track(self, actor_id: str, delta: int):
+        with self._lock:
+            self._inflight[actor_id] = self._inflight.get(actor_id, 0) + delta
+
+
+class _ResponseFuture:
+    """Lazy result of a handle call (reference: DeploymentResponse)."""
+
+    def __init__(self, router: _Router, actor_id: str, ref):
+        self._router = router
+        self._actor_id = actor_id
+        self._ref = ref
+        self._done = False
+
+    def result(self, timeout: Optional[float] = 60.0):
+        import ray_tpu as ray
+
+        try:
+            return ray.get(self._ref, timeout=timeout)
+        finally:
+            if not self._done:
+                self._done = True
+                self._router.track(self._actor_id, -1)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._router: Optional[_Router] = None
+
+    def _get_router(self) -> _Router:
+        if self._router is None:
+            import ray_tpu as ray
+
+            from .controller import CONTROLLER_NAME
+
+            controller = ray.get_actor(CONTROLLER_NAME)
+            self._router = _Router(self.deployment_name, controller)
+        return self._router
+
+    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name, method_name or self._method
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, name)
+
+    def remote(self, *args, **kwargs) -> _ResponseFuture:
+        router = self._get_router()
+        replica = router.choose()
+        router.track(replica.actor_id, +1)
+        ref = replica.handle_request.remote(
+            method=self._method, args=args, kwargs=kwargs
+        )
+        return _ResponseFuture(router, replica.actor_id, ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self._method))

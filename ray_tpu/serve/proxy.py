@@ -1,0 +1,109 @@
+"""HTTP proxy: the ingress data plane.
+
+Reference: serve/_private/proxy.py:1008 (ProxyActor, uvicorn+ASGI HTTPProxy
+:696). Here aiohttp (no uvicorn in the image): one proxy actor serves HTTP,
+maps route prefixes to deployments, and forwards through the same pow-2
+router as Python handles.
+
+Request mapping: JSON body -> deployment __call__(payload) -> JSON reply
+(dict/list/str/number), with application/octet-stream passthrough for
+bytes.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._handles: Dict[str, Any] = {}
+        self._started = threading.Event()
+        self._num_requests = 0
+        from .._private.rpc import EventLoopThread
+
+        self._loop = EventLoopThread.get().loop
+        fut = asyncio.run_coroutine_threadsafe(self._start(), self._loop)
+        fut.result(30)
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._runner = runner
+        self._started.set()
+
+    def update_routes(self, routes: Dict[str, str]) -> bool:
+        self._routes = dict(routes)
+        return True
+
+    def address(self):
+        return [self.host, self.port]
+
+    def get_num_requests(self) -> int:
+        return self._num_requests
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        self._num_requests += 1
+        path = "/" + request.match_info["tail"]
+        target = None
+        longest = -1
+        for prefix, dep in self._routes.items():
+            if path.startswith(prefix) and len(prefix) > longest:
+                target, longest = dep, len(prefix)
+        if target is None:
+            return web.json_response(
+                {"error": f"no route for {path}"}, status=404
+            )
+        if request.method == "GET" and path.endswith("/-/healthz"):
+            return web.Response(text="ok")
+        body = await request.read()
+        payload: Any = None
+        if body:
+            ctype = request.content_type or ""
+            if "json" in ctype:
+                payload = json.loads(body)
+            elif ctype.startswith("text/"):
+                payload = body.decode()
+            else:
+                try:
+                    payload = json.loads(body)
+                except Exception:
+                    payload = body
+        handle = self._handles.get(target)
+        if handle is None:
+            from .handle import DeploymentHandle
+
+            handle = DeploymentHandle(target)
+            self._handles[target] = handle
+
+        # run the blocking result() off the event loop
+        loop = asyncio.get_running_loop()
+
+        def call():
+            return handle.remote(payload).result(timeout=120)
+
+        try:
+            result = await loop.run_in_executor(None, call)
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=500
+            )
+        if isinstance(result, bytes):
+            return web.Response(body=result,
+                                content_type="application/octet-stream")
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)

@@ -1,0 +1,66 @@
+"""Replica actor: wraps one instance of the user's deployment class.
+
+Reference: serve/_private/replica.py:909 (ReplicaActor) +
+UserCallableWrapper (:1137) — executes user methods with a concurrency
+cap, counts ongoing requests for the router/autoscaler, and exposes
+health checks.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class ReplicaActor:
+    def __init__(self, serialized_cls: bytes, init_args: bytes,
+                 max_ongoing_requests: int = 100):
+        cls = cloudpickle.loads(serialized_cls)
+        args, kwargs = cloudpickle.loads(init_args)
+        self.user = cls(*args, **kwargs)
+        self.max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._start = time.time()
+
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        """Run a user method (sync methods hop to a thread; async run on
+        the actor loop, interleaving like reference async replicas)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = getattr(self.user, method)
+            if inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, lambda: target(*args, **kwargs)
+            )
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "uptime_s": time.time() - self._start,
+        }
+
+    def check_health(self) -> bool:
+        checker = getattr(self.user, "check_health", None)
+        if checker is not None:
+            checker()
+        return True
+
+    def reconfigure(self, user_config: Any) -> bool:
+        fn = getattr(self.user, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True

@@ -1,0 +1,167 @@
+"""ray_tpu.serve tests (reference: serve test surface, small scale)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_start():
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    serve.shutdown()
+    ray.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup(serve_start):
+    yield
+    import time as _t
+
+    try:
+        for name in list(serve.status()["deployments"]):
+            serve.delete(name)
+        deadline = _t.time() + 60
+        while _t.time() < deadline and any(
+            d["num_replicas"] > 0
+            for d in serve.status()["deployments"].values()
+        ):
+            _t.sleep(0.3)
+    except Exception:
+        pass
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def test_deploy_and_handle_call(serve_start):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            return {"result": x["v"] * 2}
+
+    handle = serve.run(Doubler.bind(), _http=False)
+    out = handle.remote({"v": 21}).result(timeout=120)
+    assert out == {"result": 42}
+    # several calls land across replicas without error
+    futs = [handle.remote({"v": i}) for i in range(10)]
+    assert [f.result(timeout=60)["result"] for f in futs] == [
+        i * 2 for i in range(10)
+    ]
+
+
+def test_http_proxy_roundtrip(serve_start):
+    @serve.deployment(route_prefix="/echo")
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind(), http_port=18642)
+    time.sleep(0.5)
+    out = _post("http://127.0.0.1:18642/echo", {"hello": "world"})
+    assert out == {"echo": {"hello": "world"}}
+
+
+def test_method_call_via_handle(serve_start):
+    @serve.deployment
+    class Calc:
+        def add(self, a, b):
+            return a + b
+
+        async def amul(self, a, b):
+            return a * b
+
+    handle = serve.run(Calc.bind(), _http=False)
+    assert handle.add.remote(2, 3).result(timeout=60) == 5
+    assert handle.amul.remote(4, 5).result(timeout=60) == 20
+
+
+def test_init_args_and_user_state(serve_start):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, payload):
+            return f"{self.greeting}, {payload}"
+
+    handle = serve.run(Greeter.bind("hej"), _http=False)
+    assert handle.remote("ray").result(timeout=60) == "hej, ray"
+
+
+def test_status_and_scale_config(serve_start):
+    @serve.deployment(num_replicas=3)
+    class S:
+        def __call__(self, p):
+            return "ok"
+
+    serve.run(S.bind(), _http=False)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = serve.status()
+        if st["deployments"].get("S", {}).get("num_replicas") == 3:
+            break
+        time.sleep(0.5)
+    assert serve.status()["deployments"]["S"]["num_replicas"] == 3
+
+
+def test_replica_recovers_after_death(serve_start):
+    import os
+
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self, p):
+            if p == "die":
+                os._exit(1)
+            return "alive"
+
+    handle = serve.run(Fragile.bind(), _http=False)
+    assert handle.remote("hi").result(timeout=60) == "alive"
+    try:
+        handle.remote("die").result(timeout=30)
+    except Exception:
+        pass
+    # controller detects the dead replica and replaces it
+    deadline = time.time() + 90
+    ok = False
+    while time.time() < deadline:
+        try:
+            if handle.remote("hi").result(timeout=15) == "alive":
+                ok = True
+                break
+        except Exception:
+            time.sleep(1)
+    assert ok, "replica was not replaced"
+
+
+def test_serve_batch(serve_start):
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def get_batches(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), _http=False)
+    futs = [handle.remote(i) for i in range(8)]
+    results = [f.result(timeout=60) for f in futs]
+    assert sorted(results) == [i * 10 for i in range(8)]
+    sizes = handle.get_batches.remote().result(timeout=30)
+    assert max(sizes) > 1  # calls were actually coalesced
