@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextlib
 import os
 import threading
 import time
@@ -38,6 +39,7 @@ from .rpc import (
     RpcApplicationError,
     RpcClient,
     RpcConnectionError,
+    RpcNotDeliveredError,
     RpcServer,
 )
 
@@ -84,6 +86,25 @@ def global_worker() -> "CoreWorker":
     return _global_worker
 
 
+# Active while packing task args: collects ObjectRefs encountered during
+# pickling so refs nested inside containers are retained in-flight too
+# (reference: reference_count.h counts submitted-task args recursively).
+_arg_ref_collector = threading.local()
+
+
+@contextlib.contextmanager
+def collecting_refs(out: list):
+    """Collect every ObjectRef pickled inside the block into ``out`` —
+    including refs captured in function/class globals or closures, which
+    cloudpickle embeds by value at dump time."""
+    prev = getattr(_arg_ref_collector, "refs", None)
+    _arg_ref_collector.refs = out
+    try:
+        yield out
+    finally:
+        _arg_ref_collector.refs = prev
+
+
 def _rehydrate_ref(oid_bytes: bytes, owner_addr):
     ref = ObjectRef(ObjectID(oid_bytes), tuple(owner_addr) if owner_addr else None,
                     _register=False)
@@ -112,6 +133,9 @@ class ObjectRef:
         return self.id.task_id()
 
     def __reduce__(self):
+        refs = getattr(_arg_ref_collector, "refs", None)
+        if refs is not None:
+            refs.append(self)
         # Mark the owner record: a pickled ref may be in flight to a new
         # borrower, so its free must wait out a grace window.
         w = _global_worker
@@ -292,6 +316,9 @@ class CoreWorker:
         self._sched_classes: Dict[tuple, "_LeasePool"] = {}
         self._sched_lock = threading.Lock()
 
+        # actor-creation args pinned until the actor dies (by actor_id hex)
+        self._creation_retained: Dict[str, list] = {}
+
         # actor submitters (by actor_id hex)
         self._actor_subs: Dict[str, "_ActorSubmitter"] = {}
 
@@ -301,6 +328,10 @@ class CoreWorker:
         # per-caller expected sequence numbers (ordered actor queues;
         # reference: actor_scheduling_queue.cc)
         self._actor_next_seq: Dict[str, int] = collections.defaultdict(int)
+        # Per-caller seqs the caller abandoned (failed client-side without
+        # delivery): the ordered queue skips them instead of waiting forever
+        # (reference: client_processed_up_to in PushTask).
+        self._actor_abandoned: Dict[str, set] = collections.defaultdict(set)
         self._actor_seq_cond: Optional[asyncio.Condition] = None
         self._max_concurrency = 1
         self._actor_executor: Optional[ThreadPoolExecutor] = None
@@ -538,7 +569,14 @@ class CoreWorker:
                 raise serialization.loads(info["error"])
             if "inline" in info:
                 value = serialization.loads(info["inline"])
-                self.memory_store.put(ref.id, value)
+                with self._records_lock:
+                    tracked = ref.id.binary() in self._borrowed
+                if tracked:
+                    # Cache only refs with a borrowed-ref entry: that entry's
+                    # release deletes this cache. Untracked refs (e.g. task
+                    # args resolved in a pool worker) must not populate the
+                    # memory store — nothing would ever evict them.
+                    self.memory_store.put(ref.id, value)
                 return self._maybe_raise(value)
             value = self._read_shm_anywhere(
                 ref.id, info.get("locations", ()), deadline
@@ -773,21 +811,28 @@ class CoreWorker:
         strategy_params: Optional[dict] = None,
         name: str = "",
         serialized_func: Optional[bytes] = None,
+        func_refs: Sequence["ObjectRef"] = (),
     ) -> List[ObjectRef]:
         self._task_counter += 1
         task_id = TaskID.for_job(self.job_id)
         demand = dict(demand or {"CPU": 1.0})
         if max_retries is None:
             max_retries = self._cfg.default_task_max_retries
+        if serialized_func is None:
+            # collect refs embedded in the function's globals/closure too
+            func_refs = list(func_refs)
+            with collecting_refs(func_refs):
+                serialized_func = cloudpickle.dumps(func)
+        packed_args, packed_kwargs, arg_refs = self._pack_call_args(
+            args, kwargs, extra_refs=func_refs
+        )
         spec = {
             "task_id": task_id.binary(),
             "job_id": self.job_id.hex(),
             "name": name or getattr(func, "__name__", "task"),
-            "func": serialized_func
-            if serialized_func is not None
-            else cloudpickle.dumps(func),
-            "args": self._pack_args(args),
-            "kwargs": {k: self._pack_arg(v) for k, v in kwargs.items()},
+            "func": serialized_func,
+            "args": packed_args,
+            "kwargs": packed_kwargs,
             "num_returns": num_returns,
             "demand": demand,
             "strategy": strategy,
@@ -796,9 +841,6 @@ class CoreWorker:
         }
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
-        ]
-        arg_refs = [a for a in args if isinstance(a, ObjectRef)] + [
-            v for v in kwargs.values() if isinstance(v, ObjectRef)
         ]
         for r in arg_refs:
             self._retain_ref(r.id, r.owner_address)
@@ -822,13 +864,30 @@ class CoreWorker:
             for oid in return_ids
         ]
 
-    def _pack_args(self, args):
-        return [self._pack_arg(a) for a in args]
-
     def _pack_arg(self, a):
         if isinstance(a, ObjectRef):
             return ("ref", a.id.binary(), a.owner_address)
         return ("v", serialization.dumps(a))
+
+    def _pack_call_args(self, args, kwargs, extra_refs=()):
+        """Pack args/kwargs and return every ObjectRef they carry — including
+        refs nested inside containers, captured via ObjectRef.__reduce__
+        while pickling — so the caller can retain them until the task
+        finishes (reference: reference_count.h in-flight arg counting).
+        ``extra_refs``: refs collected elsewhere (e.g. inside the serialized
+        function's globals/closure) to merge in."""
+        nested: list = []
+        with collecting_refs(nested):
+            packed_args = [self._pack_arg(a) for a in args]
+            packed_kwargs = {k: self._pack_arg(v) for k, v in kwargs.items()}
+        refs = [a for a in args if isinstance(a, ObjectRef)]
+        refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        seen = {r.id.binary() for r in refs}
+        for r in list(nested) + list(extra_refs):
+            if r.id.binary() not in seen:
+                seen.add(r.id.binary())
+                refs.append(r)
+        return packed_args, packed_kwargs, refs
 
     def _lease_pool(self, demand, strategy, strategy_params) -> "_LeasePool":
         params = strategy_params or {}
@@ -947,21 +1006,34 @@ class CoreWorker:
         strategy_params: Optional[dict] = None,
         runtime_env: Optional[dict] = None,
         serialized_cls: Optional[bytes] = None,
+        cls_refs: Sequence["ObjectRef"] = (),
         methods: Optional[dict] = None,
     ) -> str:
         actor_id = ActorID.of(self.job_id).hex()
+        if serialized_cls is None:
+            cls_refs = list(cls_refs)
+            with collecting_refs(cls_refs):
+                serialized_cls = cloudpickle.dumps(cls)
+        packed_args, packed_kwargs, arg_refs = self._pack_call_args(
+            args, kwargs, extra_refs=cls_refs
+        )
         creation = cloudpickle.dumps(
             {
-                "cls": serialized_cls
-                if serialized_cls is not None
-                else cloudpickle.dumps(cls),
-                "args": self._pack_args(args),
-                "kwargs": {k: self._pack_arg(v) for k, v in kwargs.items()},
+                "cls": serialized_cls,
+                "args": packed_args,
+                "kwargs": packed_kwargs,
                 "max_concurrency": max_concurrency,
                 "actor_id": actor_id,
                 "owner_address": list(self.address),
             }
         )
+        # Constructor args stay pinned until the actor is DEAD: restarts
+        # re-run the creation task and need them again (reference:
+        # reference_count.h keeps actor-creation args while restartable).
+        for r in arg_refs:
+            self._retain_ref(r.id, r.owner_address)
+        if arg_refs:
+            self._creation_retained[actor_id] = [r.id for r in arg_refs]
         params = strategy_params or {}
         spec = {
             "actor_id": actor_id,
@@ -985,11 +1057,19 @@ class CoreWorker:
         }
         res = self.gcs.register_actor(spec=spec)
         if not res.get("ok"):
+            self._release_actor_creation_refs(actor_id)
             raise ValueError(res.get("error", "actor registration failed"))
         self._actor_subs[actor_id] = _ActorSubmitter(
             self, actor_id, max_task_retries
         )
         return actor_id
+
+    def _release_actor_creation_refs(self, actor_id: Optional[str]):
+        refs = (
+            self._creation_retained.pop(actor_id, None) if actor_id else None
+        )
+        for oid in refs or ():
+            self._release_ref(oid)
 
     def actor_submitter(self, actor_id: str,
                         max_task_retries: int = 0) -> "_ActorSubmitter":
@@ -1013,19 +1093,19 @@ class CoreWorker:
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
+        packed_args, packed_kwargs, arg_refs = self._pack_call_args(
+            args, kwargs
+        )
         spec = {
             "task_id": task_id.binary(),
             "job_id": self.job_id.hex(),
             "name": method_name,
             "method": method_name,
-            "args": self._pack_args(args),
-            "kwargs": {k: self._pack_arg(v) for k, v in kwargs.items()},
+            "args": packed_args,
+            "kwargs": packed_kwargs,
             "num_returns": num_returns,
             "owner_address": list(self.address),
         }
-        arg_refs = [a for a in args if isinstance(a, ObjectRef)] + [
-            v for v in kwargs.values() if isinstance(v, ObjectRef)
-        ]
         for r in arg_refs:
             self._retain_ref(r.id, r.owner_address)
         with self._records_lock:
@@ -1143,7 +1223,7 @@ class CoreWorker:
         return {"ok": True, "address": list(self.address)}
 
     async def _rpc_push_actor_task(self, spec: dict, seq: int, caller: str,
-                                   incarnation: int = 0):
+                                   abandoned: tuple = ()):
         """Ordered actor task execution (reference:
         actor_scheduling_queue.cc): per-caller sequence numbers enforce
         submission order; async-def methods interleave on the io loop
@@ -1158,11 +1238,29 @@ class CoreWorker:
         serialize_execution = self._max_concurrency == 1 and not is_async
         # wait (on the loop, no thread blocked) until it's our turn
         async with self._actor_seq_cond:
-            await self._actor_seq_cond.wait_for(
-                lambda: self._actor_next_seq[caller] >= seq
-            )
+            if abandoned:
+                self._actor_abandoned[caller].update(abandoned)
+                self._actor_seq_cond.notify_all()
+
+            def _my_turn():
+                # advance over seqs the caller abandoned so a client-side
+                # failure never leaves a permanent gap
+                ab = self._actor_abandoned[caller]
+                nxt = self._actor_next_seq[caller]
+                while nxt in ab:
+                    nxt += 1
+                self._actor_next_seq[caller] = nxt
+                ab.difference_update({s for s in ab if s < nxt})
+                return nxt >= seq
+
+            await self._actor_seq_cond.wait_for(_my_turn)
             if not serialize_execution:
-                self._actor_next_seq[caller] = seq + 1
+                # max(): a client-side retry may redeliver an old seq after
+                # later seqs already advanced the counter — regressing it
+                # would wedge every task waiting on the higher value.
+                self._actor_next_seq[caller] = max(
+                    self._actor_next_seq[caller], seq + 1
+                )
                 self._actor_seq_cond.notify_all()
         loop = asyncio.get_running_loop()
         try:
@@ -1201,7 +1299,9 @@ class CoreWorker:
         finally:
             if serialize_execution:
                 async with self._actor_seq_cond:
-                    self._actor_next_seq[caller] = seq + 1
+                    self._actor_next_seq[caller] = max(
+                        self._actor_next_seq[caller], seq + 1
+                    )
                     self._actor_seq_cond.notify_all()
 
     def _execute_actor_task_sync(self, spec: dict):
@@ -1280,6 +1380,10 @@ class CoreWorker:
                     subscribed = False
                     continue
                 for _channel, msg in msgs:
+                    if msg.get("event") == "dead":
+                        self._release_actor_creation_refs(
+                            msg.get("actor_id")
+                        )
                     sub = self._actor_subs.get(msg.get("actor_id"))
                     if sub is not None:
                         sub.on_actor_event(msg)
@@ -1315,6 +1419,11 @@ class _LeasePool:
         self.num_leases = 0
         self.pending_lease_requests = 0
         self.lock = threading.Lock()
+        # Cached CREATED-PG placement: immutable post-commit, so one fetch
+        # serves every lease (invalidated when a lease attempt fails).
+        self._pg_placement: Optional[list] = None
+        # One in-flight resolution shared by all concurrent lease requests
+        self._pg_resolve_fut: Optional[asyncio.Future] = None
 
     def enqueue(self, spec: dict):
         loop = EventLoopThread.get()
@@ -1348,6 +1457,76 @@ class _LeasePool:
                     return
             asyncio.ensure_future(self._dispatch(lease, spec))
 
+    async def _resolve_pg_node(self, pg_id: str) -> Optional[str]:
+        """Pick the node owning this request's target bundle; waits for the
+        PG to be CREATED. Returns None after handling the failure/abort
+        bookkeeping itself (counter decrement + fail/requeue)."""
+        w = self.worker
+        bidx = self.params.get("bundle_index", -1)
+        bidx = -1 if bidx is None else bidx
+        placement = self._pg_placement
+        if placement is None:
+            if self._pg_resolve_fut is None:
+                # leader: poll the GCS; followers share this resolution
+                # instead of each running their own 50-500ms poll stream.
+                fut = asyncio.get_running_loop().create_future()
+                self._pg_resolve_fut = fut
+                failure: Optional[str] = None
+                try:
+                    poll = 0.05
+                    while True:
+                        if w._exit.is_set():
+                            break
+                        pg = await w.gcs.aio.call(
+                            "get_placement_group", pg_id=pg_id
+                        )
+                        if pg is None or pg.get("state") == "REMOVED":
+                            failure = f"placement group {pg_id} removed"
+                            break
+                        if (
+                            pg.get("state") == "CREATED"
+                            and pg.get("placement")
+                        ):
+                            self._pg_placement = pg["placement"]
+                            break
+                        # PENDING (possibly forever if infeasible): tasks
+                        # WAIT, like other infeasible work; back off.
+                        await asyncio.sleep(poll)
+                        poll = min(poll * 1.5, 0.5)
+                finally:
+                    self._pg_resolve_fut = None
+                    fut.set_result(None)
+                if failure is not None:
+                    with self.lock:
+                        self.pending_lease_requests -= 1
+                    self._fail_all(RayError(failure))
+                    return None
+            else:
+                await self._pg_resolve_fut
+            placement = self._pg_placement
+            if placement is None:
+                # resolution aborted (shutdown) or failed (leader already
+                # failed the queue); just release this request slot.
+                with self.lock:
+                    self.pending_lease_requests -= 1
+                return None
+        if bidx >= len(placement):
+            with self.lock:
+                self.pending_lease_requests -= 1
+            self._fail_all(RayError(
+                f"bundle_index {bidx} out of range for placement "
+                f"group {pg_id} with {len(placement)} bundles"
+            ))
+            return None
+        if bidx >= 0:
+            return placement[bidx]
+        # -1 = any bundle: rotate lease requests over the PG's nodes so
+        # unpinned tasks use every bundle.
+        self._pg_cursor = (
+            getattr(self, "_pg_cursor", -1) + 1
+        ) % len(placement)
+        return placement[self._pg_cursor]
+
     async def _request_lease(self, address: Optional[tuple] = None):
         w = self.worker
         try:
@@ -1371,23 +1550,47 @@ class _LeasePool:
                     cli = w._pool.get(
                         *view[alive[self._spread_cursor]]["address"]
                     )
+            pg_id = self.params.get("placement_group_id")
             target = self.params.get("node_id")
+            on_dead = "spill" if self.params.get("soft") else "fail"
+            if address is None and pg_id is not None:
+                # Route the lease to the raylet owning the target bundle —
+                # bundles are node-local state, so the caller's raylet can
+                # never satisfy a bundle committed elsewhere (reference: the
+                # GCS actor scheduler leases from the bundle's node).
+                target = await self._resolve_pg_node(pg_id)
+                if target is None:
+                    return  # _resolve_pg_node did the bookkeeping
+                on_dead = "retry"
             if address is None and target is not None:
-                # NodeAffinity: lease directly from the target node's raylet
-                # (reference: node_affinity_scheduling_policy.cc).
+                # Lease directly from the target node's raylet (reference:
+                # node_affinity_scheduling_policy.cc; PG routes here too).
                 view = await w.gcs.aio.call("get_cluster_view")
                 node = view.get(target)
                 if node is None or not node.get("alive"):
-                    if not self.params.get("soft"):
+                    if on_dead == "fail":
                         with self.lock:
                             self.pending_lease_requests -= 1
                         self._fail_all(
                             RayError(f"affinity node {target} is gone")
                         )
                         return
+                    if on_dead == "retry":
+                        # bundle node died: the GCS reschedules the PG —
+                        # drop the cached placement and retry.
+                        self._pg_placement = None
+                        with self.lock:
+                            self.pending_lease_requests -= 1
+                        await asyncio.sleep(0.2)
+                        asyncio.ensure_future(self._pump())
+                        return
+                    # soft affinity: fall back to the local raylet w/ spill
                 else:
                     cli = w._pool.get(*node["address"])
-                    allow_spill = bool(self.params.get("soft"))
+                    allow_spill = (
+                        bool(self.params.get("soft")) if pg_id is None
+                        else False
+                    )
             reply = await cli.call(
                 "lease_worker",
                 demand=self.demand,
@@ -1397,11 +1600,17 @@ class _LeasePool:
                 allow_spill=allow_spill,
             )
         except Exception:
+            self._pg_placement = None  # placement may be stale
             with self.lock:
                 self.pending_lease_requests -= 1
             await asyncio.sleep(0.2)
             asyncio.ensure_future(self._pump())
             return
+        if reply.get("pg_gone"):
+            # Raylet no longer hosts any bundle of the PG (released or
+            # rescheduled): re-resolve from the GCS next round, which also
+            # fails the queue if the PG was removed.
+            self._pg_placement = None
         if reply.get("ok"):
             lease = reply
             with self.lock:
@@ -1464,7 +1673,18 @@ class _LeasePool:
         addr = lease["worker_address"]
         cli = w._pool.get(addr[0], int(addr[1]))
         try:
-            reply = await cli.call("push_task", spec=spec)
+            # Non-idempotent: a mid-call connection drop must not replay the
+            # push (the worker may have executed it); _on_task_failed below
+            # applies the task's own max_retries policy instead.
+            reply = await cli.call("push_task", spec=spec, idempotent=False)
+        except RpcNotDeliveredError:
+            # The push never reached the worker (it died before connect):
+            # resubmit without consuming max_retries — nothing executed.
+            with self.lock:
+                self.num_leases -= 1
+            await self._return_lease(lease, ok=False)
+            self.enqueue(spec)
+            return
         except (RpcConnectionError, RpcApplicationError) as e:
             with self.lock:
                 self.num_leases -= 1
@@ -1512,7 +1732,14 @@ class _ActorSubmitter:
         self.max_task_retries = max_task_retries
         self.state = "PENDING"
         self.address: Optional[tuple] = None
+        self._last_addr: Optional[tuple] = None  # last resolved address
         self.incarnation = 0
+        self._restarts_seen: Optional[int] = None  # GCS restarts counter
+        self._restart_pending = False  # "restarting" event observed
+        # Seqs failed client-side without (certain) delivery: shipped with
+        # every push so the actor's ordered queue can skip the gap
+        # (reference: client_processed_up_to in core_worker.proto PushTask).
+        self._abandoned: set = set()
         self.seq = 0
         self.queue: collections.deque = collections.deque()
         self.lock = threading.Lock()
@@ -1536,13 +1763,53 @@ class _ActorSubmitter:
                 return
             specs = list(self.queue)
             self.queue.clear()
-            # Sequence numbers are assigned at dispatch, scoped to the
-            # current incarnation (a restarted actor starts expecting 0).
+            # Preserve submission order: requeued specs keep their previous
+            # _seq (assigned in submission order), never-dispatched specs
+            # have none and were submitted later; stable sort restores the
+            # caller's order.
+            specs.sort(key=lambda s: s.get("_seq", float("inf")))
+            # Sequence numbers are assigned at first dispatch, scoped to an
+            # incarnation (a restarted actor starts expecting 0). A spec
+            # requeued within the SAME incarnation keeps its seq — getting
+            # a fresh one from the advanced counter would leave the old
+            # seq as a permanent gap and deadlock the actor-side ordered
+            # queue (reference: client_processed_up_to in PushTask).
             for spec in specs:
-                spec["_seq"] = self.seq
-                self.seq += 1
+                if (
+                    "_seq" not in spec
+                    or spec.get("_inc") != self.incarnation
+                ):
+                    spec["_seq"] = self.seq
+                    spec["_inc"] = self.incarnation
+                    self.seq += 1
         for spec in specs:
             asyncio.ensure_future(self._send(spec))
+
+    def _adopt_address(self, new_addr: tuple, restarts: Optional[int] = None):
+        """Adopt a (re)resolved actor address; caller holds self.lock.
+
+        A restart means a NEW worker process, so seq expectations reset.
+        Signals (any one suffices): address changed vs the last RESOLVED
+        address (failure paths clear self.address to None, which must not
+        count), the GCS restarts counter moved (authoritative — catches a
+        recycled host:port), or a "restarting" pubsub event was seen.
+        Re-resolving the same unrestarted actor keeps seq state, or
+        ordered dispatch would break for requeued specs."""
+        is_new = self._last_addr is not None and new_addr != self._last_addr
+        if restarts is not None:
+            if self._restarts_seen is not None and restarts != self._restarts_seen:
+                is_new = True
+            self._restarts_seen = restarts
+        if self._restart_pending:
+            is_new = True
+            self._restart_pending = False
+        if is_new:
+            self.incarnation += 1
+            self.seq = 0
+            self._abandoned.clear()
+        self._last_addr = new_addr
+        self.address = new_addr
+        self.state = "ALIVE"
 
     async def _resolve_address(self):
         w = self.worker
@@ -1562,16 +1829,10 @@ class _ActorSubmitter:
                 state = info["state"]
                 if state == "ALIVE" and info.get("address"):
                     with self.lock:
-                        new_addr = tuple(info["address"])
-                        if (
-                            info.get("restarts", 0) != self.incarnation
-                            or new_addr != self.address
-                        ):
-                            # fresh incarnation: its seq expectations reset
-                            self.incarnation = info.get("restarts", 0)
-                            self.seq = 0
-                        self.address = new_addr
-                        self.state = "ALIVE"
+                        self._adopt_address(
+                            tuple(info["address"]),
+                            restarts=info.get("restarts"),
+                        )
                     break
                 if state == "DEAD":
                     with self.lock:
@@ -1622,25 +1883,50 @@ class _ActorSubmitter:
             await self._pump()
             return
         cli = w._pool.get(*addr)
+        sent_abandoned = sorted(self._abandoned)
         try:
+            # Non-idempotent: transparent RPC-level replay would double-
+            # execute the method (the actor-side seq check passes on a
+            # replay); the except-path below applies max_task_retries.
             reply = await cli.call(
                 "push_actor_task", spec={k: v for k, v in spec.items()
                                          if not k.startswith("_")},
                 seq=spec["_seq"], caller=w.worker_id,
-                incarnation=self.incarnation,
+                abandoned=sent_abandoned, idempotent=False,
             )
         except RpcApplicationError as e:
             self._fail_spec(spec, serialization.dumps(
                 RayTaskError(str(e), "RpcApplicationError")))
             return
+        except RpcNotDeliveredError:
+            # The push never reached the actor (connect failed) — its
+            # address is stale (restart in progress) or it is dying. Safe
+            # to requeue WITHOUT consuming max_task_retries: nothing
+            # executed. Requeue under the lock BEFORE yielding, so a
+            # re-resolution finishing during the sleep can't let younger
+            # tasks overtake this one (_pump re-sorts by prior _seq).
+            with self.lock:
+                self.queue.append(spec)
+                self.address = None
+                self.state = "PENDING"
+            await asyncio.sleep(0.2)
+            await self._pump()
+            return
         except (RpcConnectionError, Exception) as e:  # actor process gone
+            retriable = spec.get("_retries", 0) > 0
             with self.lock:
                 self.address = None
                 self.state = "PENDING"
-            if spec.get("_retries", 0) > 0:
-                spec["_retries"] -= 1
-                with self.lock:
+                if retriable:
+                    spec["_retries"] -= 1
                     self.queue.append(spec)
+                else:
+                    # Permanently failing a dispatched seq leaves a gap in
+                    # the actor's ordered queue; record it so later pushes
+                    # tell the actor to skip over it.
+                    if spec.get("_inc") == self.incarnation:
+                        self._abandoned.add(spec["_seq"])
+            if retriable:
                 await self._pump()
             else:
                 self._fail_spec(
@@ -1652,6 +1938,7 @@ class _ActorSubmitter:
                     ),
                 )
             return
+        self._abandoned.difference_update(sent_abandoned)
         w._on_task_done(spec, reply["returns"], reply["node_id"])
 
     def on_actor_event(self, event: dict):
@@ -1659,15 +1946,11 @@ class _ActorSubmitter:
         kind = event.get("event")
         with self.lock:
             if kind == "alive":
-                new_addr = tuple(event["address"])
-                if new_addr != self.address:
-                    self.seq = 0
-                self.address = new_addr
-                self.state = "ALIVE"
+                self._adopt_address(tuple(event["address"]))
             elif kind == "restarting":
                 self.address = None
                 self.state = "PENDING"
-                self.incarnation += 1
+                self._restart_pending = True
             elif kind == "dead":
                 self.state = "DEAD"
                 self.address = None

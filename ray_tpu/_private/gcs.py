@@ -376,11 +376,27 @@ class GcsServer:
         pg_id = rec.get("placement_group_id")
         if pg_id:
             pg = self._pgs.get(pg_id)
-            if pg is None or pg["state"] != "CREATED":
-                return False
+            if pg is None or pg["state"] == "REMOVED":
+                # terminal: the PG is gone, the actor can never place
+                self._fail_actor_creation(
+                    aid, f"placement group {pg_id} removed"
+                )
+                return True
+            if pg["state"] != "CREATED":
+                return False  # still placing; retry later
             idx = rec.get("placement_group_bundle_index", 0)
+            if idx >= len(pg["placement"]):
+                self._fail_actor_creation(
+                    aid,
+                    f"bundle_index {idx} out of range for placement group "
+                    f"{pg_id} with {len(pg['placement'])} bundles",
+                )
+                return True
             if idx == -1:
-                idx = 0
+                # any bundle: rotate actors across the PG's nodes
+                idx = pg["_actor_cursor"] = (
+                    pg.get("_actor_cursor", -1) + 1
+                ) % len(pg["placement"])
             req.strategy = "NodeAffinity"
             req.affinity_node_id = pg["placement"][idx]
             req.affinity_soft = False
@@ -428,6 +444,16 @@ class GcsServer:
         )
         await self._finish_actor_creation(aid, rec, raylet, lease,
                                           worker_addr, node_id)
+
+    def _fail_actor_creation(self, aid: str, reason: str):
+        """Terminal, non-retriable creation failure (user error)."""
+        rec = self._actors.get(aid)
+        if rec is None or rec["state"] == DEAD:
+            return
+        rec["state"] = DEAD
+        rec["death_cause"] = reason
+        self._publish("ACTOR", {"event": "dead", "actor_id": aid,
+                                "reason": reason})
 
     def _requeue_actor(self, aid: str):
         rec = self._actors.get(aid)

@@ -392,21 +392,36 @@ class Raylet:
     def _bundle_key(self, pg_id, idx):
         if not pg_id:
             return None
-        return (pg_id, 0 if idx in (-1, None) else idx)
+        return (pg_id, -1 if idx in (-1, None) else idx)
 
-    def _try_acquire(self, demand: Dict[str, float], pg_key) -> bool:
+    def _try_acquire(self, demand: Dict[str, float], pg_key):
+        """Returns (ok, resolved_pg_key). A pg_key of (pg_id, -1) means
+        'any committed bundle of the group on this node' (reference:
+        bundle_index=-1 wildcard); the resolved key names the bundle the
+        resources actually came from so release is symmetric."""
         if pg_key is not None:
+            pg_id, idx = pg_key
+            if idx == -1:
+                for (pid, i), b in self._bundles.items():
+                    if (
+                        pid == pg_id
+                        and b["committed"]
+                        and resources_fit(b["available"], demand)
+                    ):
+                        subtract(b["available"], demand)
+                        return True, (pid, i)
+                return False, None
             b = self._bundles.get(pg_key)
             if b is None or not b["committed"]:
-                return False
+                return False, None
             if not resources_fit(b["available"], demand):
-                return False
+                return False, None
             subtract(b["available"], demand)
-            return True
+            return True, pg_key
         if not resources_fit(self.available, demand):
-            return False
+            return False, None
         subtract(self.available, demand)
-        return True
+        return True, None
 
     def _release_lease_resources(self, lease: _Lease):
         if lease.pg_key is not None:
@@ -439,12 +454,21 @@ class Raylet:
         pg_key = self._bundle_key(placement_group_id, bundle_index)
         demand = {k: float(v) for k, v in (demand or {}).items()}
 
+        if pg_key is not None and not any(
+            k[0] == pg_key[0] for k in self._bundles
+        ):
+            # No bundle of this PG lives here (released/rescheduled):
+            # tell the submitter to re-resolve placement from the GCS.
+            return {"ok": False, "spill_to": None, "infeasible": False,
+                    "pg_gone": True}
+
         if pg_key is None and not resources_fit(self.total, demand):
             # Never fits here; suggest somewhere it could.
             spill = self._pick_spill_node(demand)
             return {"ok": False, "spill_to": spill, "infeasible": spill is None}
 
-        if not self._try_acquire(demand, pg_key):
+        ok, resolved_key = self._try_acquire(demand, pg_key)
+        if not ok:
             if not wait:
                 return {"ok": False, "spill_to": None, "infeasible": False}
             if pg_key is None and allow_spill:
@@ -456,9 +480,10 @@ class Raylet:
             self._lease_waiters.append((demand, pg_key, fut))
             self._lease_wakeup.set()
             granted = await fut
-            if not granted:
+            if granted is False:
                 return {"ok": False, "spill_to": None, "infeasible": False}
-        return await self._grant_lease(demand, pg_key, lease_type)
+            resolved_key = granted  # the grant loop acquired + resolved
+        return await self._grant_lease(demand, resolved_key, lease_type)
 
     async def _grant_lease(self, demand, pg_key, lease_type):
         needs_tpu = any(
@@ -557,8 +582,9 @@ class Raylet:
                 demand, pg_key, fut = self._lease_waiters.popleft()
                 if fut.done():
                     continue
-                if self._try_acquire(demand, pg_key):
-                    fut.set_result(True)
+                ok, resolved = self._try_acquire(demand, pg_key)
+                if ok:
+                    fut.set_result(resolved)
                 else:
                     still_waiting.append((demand, pg_key, fut))
             self._lease_waiters = still_waiting
@@ -612,6 +638,13 @@ class Raylet:
         if b is not None:
             add(self.available, b["reserved"])
             self._lease_wakeup.set()
+        if not any(k[0] == pg_id for k in self._bundles):
+            # Last bundle of the PG left this node: waiters for it can
+            # never be granted here — unblock them so the submitter
+            # re-resolves placement (or fails on a removed PG).
+            for _d, key, fut in list(self._lease_waiters):
+                if key is not None and key[0] == pg_id and not fut.done():
+                    fut.set_result(False)
         return True
 
     # ------------------------------------------------------------------

@@ -43,6 +43,12 @@ class RpcApplicationError(RpcError):
     """Handler raised; message carries the remote traceback string."""
 
 
+class RpcNotDeliveredError(RpcConnectionError):
+    """Every attempt failed before the request frame was written: the
+    server definitely never executed the call, so the caller may safely
+    resubmit even non-idempotent work."""
+
+
 class ChaosInjectedError(RpcConnectionError):
     """Raised by the failure injector (testing only)."""
 
@@ -237,6 +243,15 @@ class RpcClient:
     Mirrors the reference's RetryableGrpcClient: transient connection errors
     are retried with backoff up to config.rpc_max_retries; application errors
     (handler raised) are NOT retried here — the caller decides.
+
+    Retry semantics (matches the reference, which only retries calls that
+    were never delivered): connect failures are always retried — the request
+    was never sent. A connection lost AFTER the request frame was written is
+    retried only for ``idempotent=True`` calls; for non-idempotent methods
+    (push_task, push_actor_task, ...) the server may already have executed
+    the first delivery, so a blind replay would double-execute — we surface
+    RpcConnectionError and let the submitter's task/actor failure handling
+    decide.
     """
 
     def __init__(self, host: str, port: int, *, retries: Optional[int] = None):
@@ -247,7 +262,10 @@ class RpcClient:
         self._retry_delay = cfg.rpc_retry_delay_s
         self._connect_timeout = cfg.rpc_connect_timeout_s
         self._seq = 0
-        self._pending: Dict[int, asyncio.Future] = {}
+        # seq -> (future, the connection it was sent on): a dying reader
+        # must only fail calls sent on ITS connection, not ones in flight
+        # on a newer connection after a reconnect.
+        self._pending: Dict[int, Tuple[asyncio.Future, Any]] = {}
         self._writer: Optional[asyncio.StreamWriter] = None
         self._reader_task: Optional[asyncio.Task] = None
         self._conn_lock: Optional[asyncio.Lock] = None
@@ -267,36 +285,55 @@ class RpcClient:
             if sock is not None:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._writer = writer
-            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+            self._reader_task = asyncio.ensure_future(
+                self._read_loop(reader, writer)
+            )
 
-    async def _read_loop(self, reader: asyncio.StreamReader):
+    async def _read_loop(self, reader: asyncio.StreamReader, writer):
         try:
             while True:
                 seq, status, payload = await _read_frame(reader)
-                fut = self._pending.pop(seq, None)
-                if fut is None or fut.done():
+                entry = self._pending.pop(seq, None)
+                if entry is None or entry[0].done():
                     continue
                 if status == 0:
-                    fut.set_result(payload)
+                    entry[0].set_result(payload)
                 else:
-                    fut.set_exception(RpcApplicationError(payload))
+                    entry[0].set_exception(RpcApplicationError(payload))
         except Exception as e:
             err = RpcConnectionError(f"connection to {self.host}:{self.port} lost: {e}")
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(err)
-            self._pending.clear()
-            self._writer = None
+            # fail only the calls sent on THIS connection
+            for seq, (fut, conn) in list(self._pending.items()):
+                if conn is writer:
+                    self._pending.pop(seq, None)
+                    if not fut.done():
+                        fut.set_exception(err)
+            if self._writer is writer:
+                self._writer = None
 
-    async def call(self, method: str, timeout: Optional[float] = None, **kwargs):
+    async def call(
+        self,
+        method: str,
+        timeout: Optional[float] = None,
+        idempotent: bool = True,
+        **kwargs,
+    ):
         last_err: Optional[Exception] = None
+        ever_sent = False
         for attempt in range(self._retries + 1):
             if self._closed:
                 raise RpcConnectionError("client closed")
             try:
                 if _get_chaos().should_fail(method):
+                    # simulate failure of THIS call only; the shared
+                    # connection (other calls in flight) stays healthy
                     raise ChaosInjectedError(f"chaos: {method}")
                 await self._ensure_connected()
+            except ChaosInjectedError as e:
+                last_err = e
+                if attempt < self._retries:
+                    await asyncio.sleep(self._retry_delay * (2**attempt))
+                continue
             except Exception as e:  # connect failure/timeout: retry
                 last_err = e
                 self._writer = None
@@ -305,11 +342,13 @@ class RpcClient:
                 continue
             self._seq += 1
             seq = self._seq
+            writer = self._writer
             fut = asyncio.get_running_loop().create_future()
-            self._pending[seq] = fut
+            self._pending[seq] = (fut, writer)
             try:
-                _write_frame(self._writer, (seq, method, kwargs))
-                await self._writer.drain()
+                ever_sent = True  # conservatively: the frame may go out
+                _write_frame(writer, (seq, method, kwargs))
+                await writer.drain()
                 if timeout is not None:
                     return await asyncio.wait_for(fut, timeout)
                 return await fut
@@ -318,20 +357,35 @@ class RpcClient:
             except asyncio.TimeoutError:
                 self._pending.pop(seq, None)
                 raise
-            except Exception as e:  # connection dropped mid-call: retry
+            except Exception as e:  # connection dropped mid-call
                 last_err = e
                 self._pending.pop(seq, None)
-                self._writer = None
+                if self._writer is writer:
+                    self._writer = None
+                if not idempotent:
+                    # The frame may have been delivered and executed;
+                    # replaying would double-execute. Fail fast.
+                    raise RpcConnectionError(
+                        f"rpc {method} to {self.host}:{self.port}: connection "
+                        f"lost after send (not retried: non-idempotent): {e}"
+                    ) from e
                 if attempt < self._retries:
                     await asyncio.sleep(self._retry_delay * (2**attempt))
-        raise RpcConnectionError(
+        cls = RpcConnectionError if ever_sent else RpcNotDeliveredError
+        raise cls(
             f"rpc {method} to {self.host}:{self.port} failed after "
             f"{self._retries + 1} attempts: {last_err}"
         )
 
-    def call_sync(self, method: str, timeout: Optional[float] = None, **kwargs):
+    def call_sync(
+        self,
+        method: str,
+        timeout: Optional[float] = None,
+        idempotent: bool = True,
+        **kwargs,
+    ):
         return EventLoopThread.get().run(
-            self.call(method, timeout=timeout, **kwargs),
+            self.call(method, timeout=timeout, idempotent=idempotent, **kwargs),
             None if timeout is None else timeout + 5.0,
         )
 

@@ -113,6 +113,9 @@ class ActorClass:
         self._cls = cls
         self._options = options
         self._pickled: Optional[bytes] = None
+        # refs embedded in the pickled class (globals/closures); see
+        # RemoteFunction._pickled_refs
+        self._pickled_refs: list = []
         self.__name__ = getattr(cls, "__name__", "ActorClass")
 
     def __call__(self, *args, **kwargs):
@@ -124,12 +127,16 @@ class ActorClass:
     def options(self, **overrides) -> "ActorClass":
         ac = ActorClass(self._cls, **{**self._options, **overrides})
         ac._pickled = self._pickled
+        ac._pickled_refs = self._pickled_refs
         return ac
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu._private.core_worker import collecting_refs
+
         worker = global_worker()
         if self._pickled is None:
-            self._pickled = cloudpickle.dumps(self._cls)
+            with collecting_refs(self._pickled_refs):
+                self._pickled = cloudpickle.dumps(self._cls)
         o = self._options
         strategy, params = _strategy_from_options(o)
         lifetime = o.get("lifetime")
@@ -148,6 +155,7 @@ class ActorClass:
             strategy_params=params,
             runtime_env=o.get("runtime_env"),
             serialized_cls=self._pickled,
+            cls_refs=self._pickled_refs,
             methods=_public_methods(self._cls),
         )
         return ActorHandle(

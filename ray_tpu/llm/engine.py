@@ -189,6 +189,16 @@ class LLMEngine:
                 for i, req in enumerate(self.slots):
                     if req is not None:
                         self._finish_with_error(i, err)
+                # decode/prefill donate the cache buffer (donate_argnums):
+                # an exception after donation leaves self.cache permanently
+                # invalid, which would fail every future request. Rebuild it.
+                from ..models.llama import init_cache
+
+                self.cache = init_cache(
+                    self.cfg, self.ecfg.max_batch_size, self.ecfg.max_seq_len
+                )
+                self.lengths[:] = 0
+                self.slots = [None] * self.ecfg.max_batch_size
                 time.sleep(0.05)
 
     def _finish_with_error(self, i: int, err: str):

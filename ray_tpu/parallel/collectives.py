@@ -82,7 +82,12 @@ class HostCollectiveGroup:
     """
 
     def __init__(self, group_name: str, world_size: int, rank: int,
-                 gcs_client=None):
+                 gcs_client=None, incarnation: int = 0):
+        """``incarnation`` must be bumped when re-creating a group under the
+        same name (e.g. a gang restart passes its restart count): it
+        namespaces the KV keys so the new group never observes a dead
+        incarnation's barrier/gather values. The creator of the gang knows
+        the count, so agreement is free."""
         if gcs_client is None:
             from .._private.core_worker import global_worker
 
@@ -91,8 +96,9 @@ class HostCollectiveGroup:
         self.group = group_name
         self.world_size = world_size
         self.rank = rank
+        self.incarnation = incarnation
         self._seq = 0
-        self._ns = f"collective:{group_name}"
+        self._ns = f"collective:{group_name}:{incarnation}"
 
     def _next_key(self, op: str) -> str:
         self._seq += 1
@@ -151,6 +157,15 @@ class HostCollectiveGroup:
     def allreduce_obj(self, value, reduce_fn: Callable = sum,
                       timeout: float = 120.0):
         return reduce_fn(self.allgather_obj(value, timeout))
+
+    def teardown(self):
+        """Best-effort deletion of this incarnation's keys (call from one
+        rank after the group is done; safe to call from all)."""
+        try:
+            for k in self.gcs.kv_keys(ns=self._ns, prefix=""):
+                self.gcs.kv_del(ns=self._ns, key=k)
+        except Exception:
+            pass
 
 
 def barrier(group: HostCollectiveGroup):

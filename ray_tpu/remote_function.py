@@ -43,9 +43,16 @@ def _strategy_from_options(o: Dict[str, Any]):
     )
 
     if isinstance(strat, PlacementGroupSchedulingStrategy):
+        bidx = strat.placement_group_bundle_index
+        n = len(strat.placement_group.bundle_specs)
+        if bidx < -1 or bidx >= n:
+            raise ValueError(
+                f"placement_group_bundle_index {bidx} out of range for a "
+                f"placement group with {n} bundles"
+            )
         return "DEFAULT", {
             "placement_group_id": strat.placement_group.id_hex,
-            "bundle_index": strat.placement_group_bundle_index,
+            "bundle_index": bidx,
         }
     if isinstance(strat, NodeAffinitySchedulingStrategy):
         return "NodeAffinity", {
@@ -62,6 +69,11 @@ class RemoteFunction:
         self._function = func
         self._options = options
         self._pickled: Optional[bytes] = None
+        # ObjectRefs embedded in the pickled function (globals/closures):
+        # holding them keeps the objects alive as long as this function
+        # object can be submitted; also passed per-submit for in-flight
+        # retention (reference: reference_count.h counts captured refs).
+        self._pickled_refs: list = []
         self.__name__ = getattr(func, "__name__", "remote_function")
         self.__doc__ = getattr(func, "__doc__", None)
 
@@ -74,12 +86,16 @@ class RemoteFunction:
     def options(self, **overrides) -> "RemoteFunction":
         rf = RemoteFunction(self._function, **{**self._options, **overrides})
         rf._pickled = self._pickled  # function bytes unchanged
+        rf._pickled_refs = self._pickled_refs
         return rf
 
     def remote(self, *args, **kwargs):
+        from ray_tpu._private.core_worker import collecting_refs
+
         worker = global_worker()
         if self._pickled is None:
-            self._pickled = cloudpickle.dumps(self._function)
+            with collecting_refs(self._pickled_refs):
+                self._pickled = cloudpickle.dumps(self._function)
         o = self._options
         strategy, params = _strategy_from_options(o)
         num_returns = o.get("num_returns", 1)
@@ -94,6 +110,7 @@ class RemoteFunction:
             strategy_params=params,
             name=o.get("name", self.__name__),
             serialized_func=self._pickled,
+            func_refs=self._pickled_refs,
         )
         if num_returns == 1:
             return refs[0]

@@ -105,6 +105,62 @@ def test_placement_group_strict_spread_and_pinning(cluster):
     ray.remove_placement_group(pg)
 
 
+def test_task_on_pg_bundle_runs_on_bundle_node(cluster):
+    """Tasks (not just actors) with a PG strategy must lease from the raylet
+    owning the target bundle — the round-1 bug left them hanging whenever
+    the bundle landed off the caller's node (ADVICE.md round 1 #2)."""
+    pg = ray.placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    whos = ray.get(
+        [
+            whoami.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(pg, i)
+            ).remote()
+            for i in range(3)
+        ],
+        timeout=120,
+    )
+    assert list(whos) == list(pg.placement)
+    ray.remove_placement_group(pg)
+
+
+def test_task_on_pg_any_bundle_uses_all_bundles(cluster):
+    """bundle_index=-1 means ANY bundle: parallel tasks must fan out over
+    every bundle instead of serializing behind bundle 0."""
+    pg = ray.placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+
+    @ray.remote
+    def where_slow():
+        # long enough that one reused lease cannot drain the whole queue
+        import time as _t
+
+        import ray_tpu.api as api
+
+        _t.sleep(1.0)
+        return api.global_worker().node_id
+
+    refs = [
+        where_slow.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg)
+        ).remote()
+        for _ in range(6)
+    ]
+    whos = ray.get(refs, timeout=150)
+    assert set(whos) == set(pg.placement)
+    ray.remove_placement_group(pg)
+
+
+def test_pg_bundle_index_out_of_range_rejected(cluster):
+    pg = ray.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    with pytest.raises(ValueError, match="out of range"):
+        whoami.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 5)
+        ).remote()
+    ray.remove_placement_group(pg)
+
+
 def test_placement_group_resources_released_on_remove(cluster):
     time.sleep(2.0)  # let prior tests' async releases land in heartbeats
     before = ray.available_resources().get("CPU", 0)
