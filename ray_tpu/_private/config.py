@@ -41,6 +41,9 @@ class Config:
     # Chunk size for node-to-node object transfer (reference: 5 MiB,
     # ray_config_def.h:333 object_manager_default_chunk_size).
     object_transfer_chunk_size: int = 5 * 1024 * 1024
+    # Concurrent chunk-read RPCs per object pull (reference: PullManager
+    # over-subscription control).
+    object_pull_chunk_concurrency: int = 8
     # Directory for shm arena files.
     shm_dir: str = "/dev/shm"
     # Spill directory for objects evicted under memory pressure.

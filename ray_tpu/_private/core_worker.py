@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import contextlib
+import hashlib
 import os
 import threading
 import time
@@ -255,6 +256,20 @@ class _ObjectRecord:
 # ---------------------------------------------------------------------------
 # Task bookkeeping (reference: task_manager.h:168)
 # ---------------------------------------------------------------------------
+class _CallerQueue:
+    """Per-caller ordered actor dispatch: next expected seq, out-of-order
+    buffer, abandoned seqs the caller told us to skip (reference:
+    actor_scheduling_queue.cc + client_processed_up_to)."""
+
+    __slots__ = ("next_seq", "buffer", "abandoned", "draining")
+
+    def __init__(self):
+        self.next_seq = 0
+        self.buffer: Dict[int, tuple] = {}
+        self.abandoned: set = set()
+        self.draining = False
+
+
 class _TaskRecord:
     __slots__ = ("spec", "retries_left", "status", "return_ids", "is_actor",
                  "retained")
@@ -319,6 +334,26 @@ class CoreWorker:
         # actor-creation args pinned until the actor dies (by actor_id hex)
         self._creation_retained: Dict[str, list] = {}
 
+        # blocked-in-get depth (worker mode): CPU release bookkeeping
+        self._block_depth = 0
+        self._block_lock = threading.Lock()
+
+        # function export-once (reference: _private/function_manager.py
+        # exports defs via GCS KV instead of shipping bytes per task)
+        self._exported_funcs: set = set()
+        self._func_cache: Dict[str, Any] = {}
+
+        # notified whenever any owned object completes: event-driven wait()
+        self._ready_cv = threading.Condition()
+
+        # batched borrower (de)registration: deserializing a container of
+        # N refs costs O(1) flush RPCs per owner instead of N
+        self._borrow_notify_lock = threading.Lock()
+        self._borrow_add_batch: Dict[tuple, set] = {}
+        self._borrow_remove_batch: Dict[tuple, set] = {}
+        self._borrow_flush_scheduled = False
+        self._borrow_flush_alock: Optional[asyncio.Lock] = None
+
         # actor submitters (by actor_id hex)
         self._actor_subs: Dict[str, "_ActorSubmitter"] = {}
 
@@ -327,12 +362,9 @@ class CoreWorker:
         self.actor_id: Optional[str] = None
         # per-caller expected sequence numbers (ordered actor queues;
         # reference: actor_scheduling_queue.cc)
-        self._actor_next_seq: Dict[str, int] = collections.defaultdict(int)
-        # Per-caller seqs the caller abandoned (failed client-side without
-        # delivery): the ordered queue skips them instead of waiting forever
-        # (reference: client_processed_up_to in PushTask).
-        self._actor_abandoned: Dict[str, set] = collections.defaultdict(set)
-        self._actor_seq_cond: Optional[asyncio.Condition] = None
+        # Per-caller ordered dispatch queues (reference:
+        # actor_scheduling_queue.cc); see _rpc_push_actor_task.
+        self._caller_queues: Dict[str, _CallerQueue] = {}
         self._max_concurrency = 1
         self._actor_executor: Optional[ThreadPoolExecutor] = None
         self._task_executor = ThreadPoolExecutor(
@@ -404,6 +436,8 @@ class CoreWorker:
         s.register_method("get_object_info", self._rpc_get_object_info)
         s.register_method("add_borrower", self._rpc_add_borrower)
         s.register_method("remove_borrower", self._rpc_remove_borrower)
+        s.register_method("add_borrowers", self._rpc_add_borrowers)
+        s.register_method("remove_borrowers", self._rpc_remove_borrowers)
         s.register_method("push_task", self._rpc_push_task)
         s.register_method("push_actor_creation", self._rpc_push_actor_creation)
         s.register_method("push_actor_task", self._rpc_push_actor_task)
@@ -457,7 +491,51 @@ class CoreWorker:
 
     def get_objects(self, refs: Sequence[ObjectRef], timeout: Optional[float]):
         deadline = None if timeout is None else time.monotonic() + timeout
+        if self.mode == "worker" and not all(
+            self._ready_locally(r) for r in refs
+        ):
+            # Blocking inside a task: temporarily give the lease's CPU back
+            # so dependent tasks can run (reference: core_worker.cc
+            # NotifyDirectCallTaskBlocked) — without this, a parent task
+            # waiting on children deadlocks a fully-occupied node.
+            with self._cpu_released():
+                return [self._get_one(r, deadline) for r in refs]
         return [self._get_one(r, deadline) for r in refs]
+
+    def _ready_locally(self, ref: ObjectRef) -> bool:
+        """Cheap readiness probe: no RPCs, local state only."""
+        if self.memory_store.contains(ref.id):
+            return True
+        with self._records_lock:
+            rec = self._records.get(ref.id.binary())
+        if rec is not None:
+            return rec.event.is_set()
+        return self.store.contains(ref.id)
+
+    @contextlib.contextmanager
+    def _cpu_released(self):
+        with self._block_lock:
+            self._block_depth += 1
+            notify = self._block_depth == 1
+        if notify:
+            try:
+                self.raylet.call_sync("notify_worker_blocked",
+                                      worker_id=self.worker_id, timeout=2.0)
+            except Exception:
+                pass
+        try:
+            yield
+        finally:
+            with self._block_lock:
+                self._block_depth -= 1
+                notify = self._block_depth == 0
+            if notify:
+                try:
+                    self.raylet.call_sync("notify_worker_unblocked",
+                                          worker_id=self.worker_id,
+                                          timeout=2.0)
+                except Exception:
+                    pass
 
     def _remaining(self, deadline) -> Optional[float]:
         if deadline is None:
@@ -592,6 +670,21 @@ class CoreWorker:
         timeout: Optional[float] = None,
         fetch_local: bool = True,
     ):
+        if self.mode == "worker":
+            n_local = sum(1 for r in refs if self._ready_locally(r))
+            if n_local < min(num_returns, len(refs)):
+                with self._cpu_released():
+                    return self._wait_inner(refs, num_returns, timeout,
+                                            fetch_local)
+        return self._wait_inner(refs, num_returns, timeout, fetch_local)
+
+    def _wait_inner(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+        fetch_local: bool = True,
+    ):
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
@@ -607,8 +700,23 @@ class CoreWorker:
                 break
             if deadline is not None and time.monotonic() >= deadline:
                 break
-            time.sleep(0.005)
+            # Owned refs: sleep on the completion condvar (notified by
+            # _on_task_done & co) — event-driven, no poll tax (reference:
+            # WaitManager). Borrowed refs still need owner polling, so cap
+            # the sleep to keep their probe cadence.
+            any_borrowed = any(
+                r.id.binary() not in self._records for r in pending
+            )
+            step = 0.02 if any_borrowed else 0.5
+            if deadline is not None:
+                step = min(step, max(0.0, deadline - time.monotonic()))
+            with self._ready_cv:
+                self._ready_cv.wait(step)
         return ready, pending
+
+    def _notify_ready(self):
+        with self._ready_cv:
+            self._ready_cv.notify_all()
 
     def _is_ready(self, ref: ObjectRef) -> bool:
         if self.memory_store.contains(ref.id):
@@ -657,10 +765,8 @@ class CoreWorker:
                 if ent[0] <= 0:
                     self._borrowed.pop(oid.binary(), None)
                     self.memory_store.delete(oid)
-                    owner = self._pool.get(*ent[1])
-                    EventLoopThread.get().spawn(
-                        owner.call("remove_borrower",
-                                   object_id=oid.binary())
+                    self._queue_borrow_notify(
+                        tuple(ent[1]), oid.binary(), add=False
                     )
 
     def _retain_ref(self, oid: ObjectID, owner_address):
@@ -697,30 +803,84 @@ class CoreWorker:
                 ent[0] += 1
                 return
             self._borrowed[ref.id.binary()] = [1, tuple(ref.owner_address)]
-        owner = self._pool.get(*ref.owner_address)
-        EventLoopThread.get().spawn(
-            owner.call("add_borrower", object_id=ref.id.binary())
+        self._queue_borrow_notify(
+            tuple(ref.owner_address), ref.id.binary(), add=True
         )
 
     async def _rpc_add_borrower(self, object_id: bytes):
-        with self._records_lock:
-            rec = self._records.get(object_id)
-            if rec is not None:
-                rec.borrowers += 1
-        return True
+        return await self._rpc_add_borrowers([object_id])
 
     async def _rpc_remove_borrower(self, object_id: bytes):
+        return await self._rpc_remove_borrowers([object_id])
+
+    async def _rpc_add_borrowers(self, object_ids: List[bytes]):
         with self._records_lock:
-            rec = self._records.get(object_id)
-            if rec is not None:
-                rec.borrowers -= 1
-                if (
-                    rec.local_refs <= 0
-                    and rec.borrowers <= 0
-                    and not rec.pending
-                ):
-                    self._free_object(ObjectID(object_id), rec)
+            for object_id in object_ids:
+                rec = self._records.get(object_id)
+                if rec is not None:
+                    rec.borrowers += 1
         return True
+
+    async def _rpc_remove_borrowers(self, object_ids: List[bytes]):
+        with self._records_lock:
+            for object_id in object_ids:
+                rec = self._records.get(object_id)
+                if rec is not None:
+                    rec.borrowers -= 1
+                    if (
+                        rec.local_refs <= 0
+                        and rec.borrowers <= 0
+                        and not rec.pending
+                    ):
+                        self._free_object(ObjectID(object_id), rec)
+        return True
+
+    def _queue_borrow_notify(self, addr: tuple, oid_bytes: bytes,
+                             add: bool):
+        """Coalesce borrower notifications per owner; flushed in-order a
+        few ms later (one RPC per owner per flush)."""
+        with self._borrow_notify_lock:
+            batch = (
+                self._borrow_add_batch if add else self._borrow_remove_batch
+            )
+            batch.setdefault(addr, set()).add(oid_bytes)
+            if self._borrow_flush_scheduled:
+                return
+            self._borrow_flush_scheduled = True
+        loop = EventLoopThread.get().loop
+        loop.call_soon_threadsafe(
+            lambda: loop.call_later(
+                0.005,
+                lambda: asyncio.ensure_future(self._flush_borrow_notifies()),
+            )
+        )
+
+    async def _flush_borrow_notifies(self):
+        if self._borrow_flush_alock is None:
+            self._borrow_flush_alock = asyncio.Lock()
+        # serialize flushes so an add in flush N can never be overtaken by
+        # the matching remove in flush N+1
+        async with self._borrow_flush_alock:
+            with self._borrow_notify_lock:
+                adds, self._borrow_add_batch = self._borrow_add_batch, {}
+                rems, self._borrow_remove_batch = (
+                    self._borrow_remove_batch, {},
+                )
+                self._borrow_flush_scheduled = False
+            for addr, oids in adds.items():
+                try:
+                    await self._pool.get(*addr).call(
+                        "add_borrowers", object_ids=list(oids)
+                    )
+                except Exception:
+                    pass
+            for addr, oids in rems.items():
+                try:
+                    await self._pool.get(*addr).call(
+                        "remove_borrowers", object_ids=list(oids)
+                    )
+                except Exception:
+                    pass
 
     def _free_object(self, oid: ObjectID, rec: _ObjectRecord):
         """Free now if no pickled copy can be in flight; otherwise wait out
@@ -826,11 +986,12 @@ class CoreWorker:
         packed_args, packed_kwargs, arg_refs = self._pack_call_args(
             args, kwargs, extra_refs=func_refs
         )
+        func_id = self._export_function(serialized_func)
         spec = {
             "task_id": task_id.binary(),
             "job_id": self.job_id.hex(),
             "name": name or getattr(func, "__name__", "task"),
-            "func": serialized_func,
+            "func_id": func_id,
             "args": packed_args,
             "kwargs": packed_kwargs,
             "num_returns": num_returns,
@@ -863,6 +1024,34 @@ class CoreWorker:
             ObjectRef(oid, self.address, _register=False)
             for oid in return_ids
         ]
+
+    def _export_function(self, serialized_func: bytes) -> str:
+        """Export the function to the GCS KV once and return its id; task
+        specs then carry the id instead of the bytes (reference:
+        _private/function_manager.py export path). Executors cache the
+        deserialized callable by id, so repeated tasks skip both the
+        per-task byte shipping and the per-task cloudpickle.loads."""
+        func_id = hashlib.sha1(serialized_func).hexdigest()
+        if func_id not in self._exported_funcs:
+            self.gcs.kv_put(ns=f"funcs:{self.job_id.hex()}", key=func_id,
+                            value=serialized_func)
+            self._exported_funcs.add(func_id)
+        return func_id
+
+    def _load_function(self, spec: dict):
+        func_id = spec.get("func_id")
+        if func_id is None:
+            return cloudpickle.loads(spec["func"])
+        fn = self._func_cache.get(func_id)
+        if fn is None:
+            data = self.gcs.kv_get(ns=f"funcs:{spec['job_id']}", key=func_id)
+            if data is None:
+                raise RuntimeError(
+                    f"function {func_id} not found in GCS function table"
+                )
+            fn = cloudpickle.loads(data)
+            self._func_cache[func_id] = fn
+        return fn
 
     def _pack_arg(self, a):
         if isinstance(a, ObjectRef):
@@ -936,6 +1125,7 @@ class CoreWorker:
                 # re-check so fire-and-forget tasks don't leak records
                 if rec.local_refs <= 0 and rec.borrowers <= 0:
                     self._free_object(oid, rec)
+        self._notify_ready()
         self._record_task_event(spec, "FINISHED")
 
     def _on_task_failed(self, spec: dict, error: Exception) -> bool:
@@ -967,6 +1157,7 @@ class CoreWorker:
             retained, task.retained = task.retained, []
             for oid in retained:
                 self._release_ref(oid)
+        self._notify_ready()
         self._record_task_event(spec, "FAILED")
         return False
 
@@ -1139,7 +1330,7 @@ class CoreWorker:
 
     def _execute_task(self, spec: dict):
         try:
-            func = cloudpickle.loads(spec["func"])
+            func = self._load_function(spec)
             args = [self._unpack_arg(a) for a in spec["args"]]
             kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
             result = func(*args, **kwargs)
@@ -1230,79 +1421,114 @@ class CoreWorker:
         (reference async actors: fiber.h); sync methods run in a pool of
         max_concurrency threads (threaded actors: thread_pool.cc).
         With max_concurrency == 1, execution itself is serialized in seq
-        order; otherwise only *dispatch* is ordered."""
-        if self._actor_seq_cond is None:
-            self._actor_seq_cond = asyncio.Condition()
-        method = getattr(self.actor_instance, spec["method"], None)
-        is_async = method is not None and asyncio.iscoroutinefunction(method)
-        serialize_execution = self._max_concurrency == 1 and not is_async
-        # wait (on the loop, no thread blocked) until it's our turn
-        async with self._actor_seq_cond:
-            if abandoned:
-                self._actor_abandoned[caller].update(abandoned)
-                self._actor_seq_cond.notify_all()
+        order; otherwise only *dispatch* is ordered.
 
-            def _my_turn():
-                # advance over seqs the caller abandoned so a client-side
-                # failure never leaves a permanent gap
-                ab = self._actor_abandoned[caller]
-                nxt = self._actor_next_seq[caller]
-                while nxt in ab:
-                    nxt += 1
-                self._actor_next_seq[caller] = nxt
-                ab.difference_update({s for s in ab if s < nxt})
-                return nxt >= seq
+        Out-of-order arrivals buffer in a per-caller map drained by ONE
+        loop coroutine — O(1) work per task, instead of a condition
+        variable waking every pending push on each completion (O(N²) for a
+        1k-deep pipeline)."""
+        q = self._caller_queues.get(caller)
+        if q is None:
+            q = self._caller_queues[caller] = _CallerQueue()
+        if abandoned:
+            q.abandoned.update(abandoned)
+        if seq < q.next_seq:
+            # client-side retry of a seq that already passed dispatch:
+            # execute immediately (at-least-once under max_task_retries)
+            return await self._run_actor_method(spec)
+        fut = asyncio.get_running_loop().create_future()
+        q.buffer[seq] = (spec, fut)
+        if not q.draining:
+            q.draining = True
+            asyncio.ensure_future(self._drain_caller_queue(q))
+        return await fut
 
-            await self._actor_seq_cond.wait_for(_my_turn)
-            if not serialize_execution:
-                # max(): a client-side retry may redeliver an old seq after
-                # later seqs already advanced the counter — regressing it
-                # would wedge every task waiting on the higher value.
-                self._actor_next_seq[caller] = max(
-                    self._actor_next_seq[caller], seq + 1
-                )
-                self._actor_seq_cond.notify_all()
-        loop = asyncio.get_running_loop()
+    async def _drain_caller_queue(self, q: "_CallerQueue"):
         try:
-            if method is None:
-                return self._actor_error_reply(
-                    spec,
-                    AttributeError(f"actor has no method {spec['method']!r}"),
+            while True:
+                while q.next_seq in q.abandoned:
+                    q.abandoned.discard(q.next_seq)
+                    q.next_seq += 1
+                entry = q.buffer.pop(q.next_seq, None)
+                if entry is None:
+                    q.abandoned = {
+                        s for s in q.abandoned if s >= q.next_seq
+                    }
+                    return
+                spec, fut = entry
+                q.next_seq += 1
+                method = getattr(self.actor_instance, spec["method"], None)
+                is_async = method is not None and asyncio.iscoroutinefunction(
+                    method
                 )
-            if is_async:
-                # arg refs may need network fetches — never block the io
-                # loop resolving them (call_sync from the loop deadlocks)
-                try:
-                    args, kwargs = await loop.run_in_executor(
-                        self._task_executor,
-                        lambda: (
-                            [self._unpack_arg(a) for a in spec["args"]],
-                            {
-                                k: self._unpack_arg(v)
-                                for k, v in spec["kwargs"].items()
-                            },
-                        ),
+                serialize = self._max_concurrency == 1 and not is_async
+                if serialize:
+                    # full execution serialization in seq order
+                    try:
+                        reply = await self._run_actor_method(spec)
+                        if not fut.done():
+                            fut.set_result(reply)
+                    except Exception as e:  # noqa: BLE001
+                        if not fut.done():
+                            fut.set_exception(e)
+                else:
+                    # ordered dispatch, concurrent execution
+                    asyncio.ensure_future(
+                        self._run_and_resolve(spec, fut)
                     )
-                    result = await method(*args, **kwargs)
-                except Exception as e:  # noqa: BLE001
-                    return self._actor_error_reply(spec, e)
-                return await loop.run_in_executor(
-                    self._task_executor,
-                    lambda: {
-                        "returns": self._pack_returns(spec, result),
-                        "node_id": self.node_id,
-                    },
-                )
-            return await loop.run_in_executor(
-                self._actor_executor, self._execute_actor_task_sync, spec
-            )
         finally:
-            if serialize_execution:
-                async with self._actor_seq_cond:
-                    self._actor_next_seq[caller] = max(
-                        self._actor_next_seq[caller], seq + 1
-                    )
-                    self._actor_seq_cond.notify_all()
+            q.draining = False
+            # a push may have arrived for the new next_seq while we exited
+            if q.next_seq in q.buffer or (
+                q.abandoned and min(q.abandoned) <= q.next_seq
+            ):
+                q.draining = True
+                asyncio.ensure_future(self._drain_caller_queue(q))
+
+    async def _run_and_resolve(self, spec: dict, fut: asyncio.Future):
+        try:
+            reply = await self._run_actor_method(spec)
+            if not fut.done():
+                fut.set_result(reply)
+        except Exception as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+
+    async def _run_actor_method(self, spec: dict):
+        loop = asyncio.get_running_loop()
+        method = getattr(self.actor_instance, spec["method"], None)
+        if method is None:
+            return self._actor_error_reply(
+                spec,
+                AttributeError(f"actor has no method {spec['method']!r}"),
+            )
+        if asyncio.iscoroutinefunction(method):
+            # arg refs may need network fetches — never block the io
+            # loop resolving them (call_sync from the loop deadlocks)
+            try:
+                args, kwargs = await loop.run_in_executor(
+                    self._task_executor,
+                    lambda: (
+                        [self._unpack_arg(a) for a in spec["args"]],
+                        {
+                            k: self._unpack_arg(v)
+                            for k, v in spec["kwargs"].items()
+                        },
+                    ),
+                )
+                result = await method(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                return self._actor_error_reply(spec, e)
+            return await loop.run_in_executor(
+                self._task_executor,
+                lambda: {
+                    "returns": self._pack_returns(spec, result),
+                    "node_id": self.node_id,
+                },
+            )
+        return await loop.run_in_executor(
+            self._actor_executor, self._execute_actor_task_sync, spec
+        )
 
     def _execute_actor_task_sync(self, spec: dict):
         method = getattr(self.actor_instance, spec["method"])
@@ -1869,6 +2095,7 @@ class _ActorSubmitter:
                     if rec.local_refs <= 0 and rec.borrowers <= 0:
                         w._free_object(oid, rec)
             task = w._tasks.get(spec["task_id"])
+        w._notify_ready()
         if task is not None:
             retained, task.retained = task.retained, []
             for oid in retained:

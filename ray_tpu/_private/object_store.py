@@ -58,6 +58,11 @@ class _Lib:
             lib.shm_store_list.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64
             ]
+            lib.shm_store_list_lru.restype = ctypes.c_uint64
+            lib.shm_store_list_lru.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ]
             cls._instance = lib
         return cls._instance
 
@@ -158,6 +163,20 @@ class ShmClient:
         raw = buf.raw
         return [
             ObjectID(raw[i * _ID_LEN : (i + 1) * _ID_LEN]) for i in range(int(n))
+        ]
+
+    def list_objects_lru(self, max_ids: int = 1 << 16) -> List[ObjectID]:
+        """Sealed objects ordered coldest-first by last-touch tick (for the
+        raylet's LRU spill policy; reference: eviction_policy.h)."""
+        buf = ctypes.create_string_buffer(max_ids * _ID_LEN)
+        ticks = (ctypes.c_uint64 * max_ids)()
+        n = int(self._lib.shm_store_list_lru(
+            self._handle, buf, ticks, ctypes.c_uint64(max_ids)
+        ))
+        raw = buf.raw
+        order = sorted(range(n), key=lambda i: ticks[i])
+        return [
+            ObjectID(raw[i * _ID_LEN : (i + 1) * _ID_LEN]) for i in order
         ]
 
     # --- object API -----------------------------------------------------

@@ -71,7 +71,8 @@ def detect_node_resources() -> Tuple[Dict[str, float], Dict[str, str]]:
 
 
 class _Lease:
-    __slots__ = ("lease_id", "worker", "demand", "pg_key", "lease_type")
+    __slots__ = ("lease_id", "worker", "demand", "pg_key", "lease_type",
+                 "released")
 
     def __init__(self, lease_id, worker, demand, pg_key, lease_type):
         self.lease_id = lease_id
@@ -79,6 +80,9 @@ class _Lease:
         self.demand = demand
         self.pg_key = pg_key
         self.lease_type = lease_type
+        # True while the worker is blocked in ray.get and its resources
+        # are temporarily returned (reference: blocked-task CPU release)
+        self.released = False
 
 
 class _WorkerHandle:
@@ -142,6 +146,7 @@ class Raylet:
         self.spill_dir = os.path.join(cfg.spill_dir, self.node_id[:12])
         os.makedirs(self.spill_dir, exist_ok=True)
         self._spilled: Dict[bytes, str] = {}  # object_id bytes -> path
+        self._inflight_pulls: Dict[bytes, asyncio.Future] = {}
 
         # worker pool — split by accelerator access: TPU chips are
         # process-exclusive (libtpu single-owner; reference handles this
@@ -371,7 +376,8 @@ class Raylet:
                     # free resources of any lease it held
                     for lid, lease in list(self._leases.items()):
                         if lease.worker.worker_id == wid:
-                            self._release_lease_resources(lease)
+                            if not lease.released:
+                                self._release_lease_resources(lease)
                             self._leases.pop(lid, None)
                     try:
                         await self.gcs.aio.call(
@@ -558,7 +564,8 @@ class Raylet:
                     break
         if lease is None:
             return False
-        self._release_lease_resources(lease)
+        if not lease.released:  # blocked workers already gave them back
+            self._release_lease_resources(lease)
         handle = lease.worker
         if ok and handle.alive and handle.proc.poll() is None:
             handle.reserved = False
@@ -588,6 +595,42 @@ class Raylet:
                 else:
                     still_waiting.append((demand, pg_key, fut))
             self._lease_waiters = still_waiting
+
+    async def notify_worker_blocked(self, worker_id: str):
+        """A leased task worker blocked in ray.get/wait: return its lease's
+        resources so dependent tasks can run instead of deadlocking the
+        node (reference: NodeManager::HandleNotifyDirectCallTaskBlocked;
+        essential on small hosts where a parent task would otherwise hold
+        the only CPU its children need)."""
+        for lease in self._leases.values():
+            if (
+                lease.worker.worker_id == worker_id
+                and lease.lease_type == "task"
+                and not lease.released
+            ):
+                lease.released = True
+                self._release_lease_resources(lease)
+        self._lease_wakeup.set()
+        return True
+
+    async def notify_worker_unblocked(self, worker_id: str):
+        """Re-acquire on wake. available may go briefly negative
+        (oversubscription while the node drains), which simply blocks new
+        leases until it recovers — same net effect as the reference."""
+        for lease in self._leases.values():
+            if (
+                lease.worker.worker_id == worker_id
+                and lease.lease_type == "task"
+                and lease.released
+            ):
+                lease.released = False
+                if lease.pg_key is not None:
+                    b = self._bundles.get(lease.pg_key)
+                    if b is not None:
+                        subtract(b["available"], lease.demand)
+                else:
+                    subtract(self.available, lease.demand)
+        return True
 
     async def kill_worker(self, worker_id: str):
         handle = self._workers.get(worker_id)
@@ -654,12 +697,29 @@ class Raylet:
     async def pull_object(self, object_id: bytes, from_address: List[Any],
                           size: Optional[int] = None):
         """Fetch a remote object into the local arena. Called by local
-        workers; idempotent."""
+        workers; idempotent. Concurrent pulls of the same object coalesce
+        onto one transfer (reference: PullManager request dedup)."""
         oid = ObjectID(object_id)
         if self.store.contains(oid):
             return True
         if object_id in self._spilled:
             return await self.restore_spilled_object(object_id)
+        existing = self._inflight_pulls.get(object_id)
+        if existing is not None:
+            return await asyncio.shield(existing)
+        fut = asyncio.get_running_loop().create_future()
+        self._inflight_pulls[object_id] = fut
+        try:
+            ok = await self._pull_object_inner(oid, object_id, from_address)
+        except Exception:
+            ok = False
+        finally:
+            self._inflight_pulls.pop(object_id, None)
+            fut.set_result(ok)
+        return ok
+
+    async def _pull_object_inner(self, oid: ObjectID, object_id: bytes,
+                                 from_address: List[Any]) -> bool:
         remote = self._pool.get(from_address[0], int(from_address[1]))
         meta = await remote.call("object_info", object_id=object_id)
         if meta is None:
@@ -671,18 +731,29 @@ class Raylet:
         except ObjectStoreFullError:
             self._ensure_space(total)
             view = self.store.create(oid, total)
-        try:
-            off = 0
-            while off < total:
-                n = min(chunk, total - off)
+        # Pipelined chunk fetches: several read RPCs in flight at once so
+        # the transfer isn't a serial chunk-by-chunk round-trip chain
+        # (reference: ObjectBufferPool chunked push + PullManager
+        # over-subscription control).
+        sem = asyncio.Semaphore(
+            max(1, self._cfg.object_pull_chunk_concurrency)
+        )
+
+        async def fetch(off: int, n: int):
+            async with sem:
                 data = await remote.call(
                     "read_object_chunk", object_id=object_id, offset=off,
                     nbytes=n,
                 )
-                if data is None:
-                    raise ConnectionError("remote chunk read failed")
-                view[off : off + len(data)] = data
-                off += len(data)
+            if data is None or len(data) != n:
+                raise ConnectionError("remote chunk read failed")
+            view[off : off + n] = data
+
+        try:
+            await asyncio.gather(*[
+                fetch(off, min(chunk, total - off))
+                for off in range(0, total, chunk)
+            ])
         except Exception:
             view.release()
             self.store.delete(oid)
@@ -741,7 +812,7 @@ class Raylet:
         need = nbytes - (stats["capacity_bytes"] - stats["used_bytes"])
         if need <= 0:
             return
-        for oid in self.store.list_objects():
+        for oid in self.store.list_objects_lru():  # coldest first
             if need <= 0:
                 break
             buf = self.store.get_buffer(oid)

@@ -1,0 +1,217 @@
+"""Core runtime microbenchmarks (`python -m ray_tpu.microbenchmark`).
+
+Mirrors the shape of the reference's `ray microbenchmark` harness
+(reference: python/ray/_private/ray_perf.py:1, invoked from
+scripts/scripts.py:2012) so the numbers line up row-for-row with the
+published v2.9.3 release logs (BASELINE.md). Writes BENCH_core.json.
+
+Timing protocol (compressed from ray_microbenchmark_helpers.timeit): short
+warmup, then REPS timed windows of WINDOW_S seconds; reports mean ± sd
+ops/sec.
+"""
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu as ray
+
+WARMUP_S = float(os.environ.get("RAY_TPU_BENCH_WARMUP_S", "0.5"))
+WINDOW_S = float(os.environ.get("RAY_TPU_BENCH_WINDOW_S", "1.5"))
+REPS = int(os.environ.get("RAY_TPU_BENCH_REPS", "3"))
+FILTER = os.environ.get("TESTS_TO_RUN", "")
+
+# v2.9.3 reference values (ops/sec) from
+# release/release_logs/2.9.3/microbenchmark.json (see BASELINE.md).
+REFERENCE = {
+    "single client get calls": 10182.0,
+    "single client put calls": 5545.0,
+    "single client put gigabytes": 20.88,
+    "single client tasks sync": 1007.0,
+    "single client tasks async": 8444.0,
+    "multi client tasks async": 25166.0,
+    "single client wait 1k refs": 5.49,
+    "1:1 actor calls sync": 2033.0,
+    "1:1 actor calls async": 8886.0,
+    "1:1 actor calls concurrent": 5095.0,
+    "1:1 async-actor calls async": 3434.0,
+    "n:n actor calls async": 27667.0,
+    "single client get object containing 10k refs": 12.39,
+}
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: float = 1,
+           results: Optional[list] = None):
+    if FILTER and FILTER not in name:
+        return
+    # warmup
+    start = time.perf_counter()
+    while time.perf_counter() - start < WARMUP_S:
+        fn()
+    stats = []
+    for _ in range(REPS):
+        count = 0
+        start = time.perf_counter()
+        while time.perf_counter() - start < WINDOW_S:
+            fn()
+            count += 1
+        stats.append(multiplier * count / (time.perf_counter() - start))
+    mean, sd = float(np.mean(stats)), float(np.std(stats))
+    ref = REFERENCE.get(name)
+    ratio = (mean / ref) if ref else None
+    line = f"{name}: {mean:.2f} +- {sd:.2f} /s"
+    if ref:
+        line += f"  (ref {ref:.2f}, {ratio:.2f}x)"
+    print(line, flush=True)
+    if results is not None:
+        results.append({
+            "name": name, "ops_per_s": round(mean, 2), "sd": round(sd, 2),
+            "reference": ref, "vs_reference": round(ratio, 3) if ratio else None,
+        })
+
+
+@ray.remote
+def small_value():
+    return b"ok"
+
+
+@ray.remote
+class Actor:
+    def small_value(self):
+        return b"ok"
+
+    def small_value_arg(self, x):
+        return b"ok"
+
+
+@ray.remote
+class AsyncActor:
+    async def small_value(self):
+        return b"ok"
+
+
+@ray.remote
+class Client:
+    """Driver-side fan-out client (reference ray_perf.py Client)."""
+
+    def __init__(self, servers):
+        self.servers = servers
+
+    def small_value_batch(self, n):
+        refs = []
+        for s in self.servers:
+            refs.extend([s.small_value.remote() for _ in range(n)])
+        ray.get(refs)
+
+
+@ray.remote
+def batch_submitter(n):
+    ray.get([small_value.remote() for _ in range(n)])
+    return 0
+
+
+@ray.remote
+def make_object_with_refs(n):
+    return [ray.put(i) for i in range(n)]
+
+
+def main() -> List[dict]:
+    results: List[dict] = []
+    # Explicit CPU slots: the benchmarks need concurrent workers even on a
+    # small host (processes timeshare; the reference runs on 64-core
+    # machines where the default suffices).
+    ray.init(resources={"CPU": float(os.environ.get(
+        "RAY_TPU_BENCH_CPUS", max(8, (os.cpu_count() or 1) * 2)))})
+    try:
+        value = ray.put(0)
+        timeit("single client get calls", lambda: ray.get(value),
+               results=results)
+        timeit("single client put calls", lambda: ray.put(0),
+               results=results)
+
+        arr = np.zeros(64 * 1024 * 1024 // 8, dtype=np.int64)  # 64 MiB
+        timeit("single client put gigabytes", lambda: ray.put(arr),
+               multiplier=64 / 1024, results=results)
+
+        timeit("single client tasks sync",
+               lambda: ray.get(small_value.remote()), results=results)
+        timeit("single client tasks async",
+               lambda: ray.get([small_value.remote() for _ in range(1000)]),
+               multiplier=1000, results=results)
+
+        n, m = 1000, 4
+        timeit(
+            "multi client tasks async",
+            lambda: ray.get(
+                [batch_submitter.remote(n) for _ in range(m)]
+            ),
+            multiplier=n * m,
+            results=results,
+        )
+
+        def wait_1k():
+            not_ready = [small_value.remote() for _ in range(1000)]
+            fetch_local = True
+            while not_ready:
+                _r, not_ready = ray.wait(not_ready,
+                                         fetch_local=fetch_local)
+                fetch_local = False
+
+        timeit("single client wait 1k refs", wait_1k, results=results)
+
+        a = Actor.remote()
+        timeit("1:1 actor calls sync",
+               lambda: ray.get(a.small_value.remote()), results=results)
+        timeit("1:1 actor calls async",
+               lambda: ray.get([a.small_value.remote() for _ in range(1000)]),
+               multiplier=1000, results=results)
+
+        ac = Actor.options(max_concurrency=16).remote()
+        timeit("1:1 actor calls concurrent",
+               lambda: ray.get([ac.small_value.remote() for _ in range(1000)]),
+               multiplier=1000, results=results)
+
+        aa = AsyncActor.remote()
+        timeit("1:1 async-actor calls async",
+               lambda: ray.get([aa.small_value.remote() for _ in range(1000)]),
+               multiplier=1000, results=results)
+
+        # n:n — n_cpu submitter actors each driving one server actor
+        n_cpu = max(2, min(8, multiprocessing.cpu_count() // 2))
+        nn = 1000
+        servers = [Actor.remote() for _ in range(n_cpu)]
+        clients = [Client.remote([s]) for s in servers]
+        timeit(
+            "n:n actor calls async",
+            lambda: ray.get(
+                [c.small_value_batch.remote(nn) for c in clients]
+            ),
+            multiplier=nn * n_cpu,
+            results=results,
+        )
+
+        refs_obj = make_object_with_refs.remote(10000)
+        ray.get(refs_obj)  # materialize once
+        timeit("single client get object containing 10k refs",
+               lambda: ray.get(refs_obj), results=results)
+    finally:
+        ray.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    out = main()
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_core.json")
+    # repo root may not be the parent (installed package): fall back to cwd
+    if not os.path.isdir(os.path.dirname(path)):
+        path = "BENCH_core.json"
+    with open(path, "w") as f:
+        json.dump({"benchmarks": out, "window_s": WINDOW_S, "reps": REPS},
+                  f, indent=2)
+    print(f"wrote {path}")

@@ -656,4 +656,23 @@ uint64_t shm_store_list(void* hs, uint8_t* out, uint64_t max_ids) {
   return n;
 }
 
+// Like shm_store_list but also writes each entry's last-touch LRU tick so
+// callers (the raylet's spill policy) can order coldest-first.
+uint64_t shm_store_list_lru(void* hs, uint8_t* out, uint64_t* ticks,
+                            uint64_t max_ids) {
+  Store* s = reinterpret_cast<Store*>(hs);
+  lock(s);
+  uint64_t n = 0;
+  Entry* t = table(s);
+  for (uint64_t i = 0; i < s->hdr->table_cap && n < max_ids; i++) {
+    if (t[i].state == kSealed) {
+      memcpy(out + n * kIdLen, t[i].id, kIdLen);
+      ticks[n] = t[i].lru;
+      n++;
+    }
+  }
+  unlock(s);
+  return n;
+}
+
 }  // extern "C"
