@@ -1,0 +1,34 @@
+"""ray_tpu.tune — hyperparameter tuning over trial actors.
+
+Reference: python/ray/tune (Tuner tuner.py:43, TuneController
+execution/tune_controller.py:68, ASHA schedulers/async_hyperband.py,
+PBT schedulers/pbt.py, search spaces search/sample.py).
+"""
+from ..train.checkpoint import Checkpoint  # noqa: F401
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .trial import Trial  # noqa: F401
+from .tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    TuneError,
+    Tuner,
+    get_checkpoint,
+    get_trial_dir,
+    get_trial_id,
+    report,
+    with_resources,
+)

@@ -1,0 +1,202 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT.
+
+Reference: python/ray/tune/schedulers/trial_scheduler.py (decision
+constants), async_hyperband.py (ASHAScheduler / _Bracket.on_result),
+median_stopping_rule.py, pbt.py (PopulationBasedTraining exploit/explore).
+Redesigned around a single on_result() hook returning a decision; the
+controller owns actor lifecycle.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .trial import Trial
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+# Trial consumed its full budget (max_t) — normal termination, not an
+# early stop (reference: Trainable stops itself at stopping criteria).
+COMPLETE = "COMPLETE"
+# (EXPLOIT, source_trial) — restart this trial from source's checkpoint
+# with a perturbed config (PBT only).
+EXPLOIT = "EXPLOIT"
+
+
+class TrialScheduler:
+    def set_metric(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def on_trial_add(self, trial: Trial):
+        pass
+
+    def on_result(self, trial: Trial, result: Dict[str, Any],
+                  trials: List[Trial]):
+        """Return CONTINUE / STOP / (EXPLOIT, source_trial)."""
+        return CONTINUE
+
+    def on_trial_complete(self, trial: Trial):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class _Rung:
+    """One ASHA rung: milestone iteration + recorded metrics."""
+
+    def __init__(self, milestone: int):
+        self.milestone = milestone
+        self.recorded: Dict[str, float] = {}
+
+    def cutoff(self, rf: float) -> Optional[float]:
+        if len(self.recorded) < rf:
+            return None
+        vals = np.asarray(list(self.recorded.values()))
+        # keep the top 1/rf fraction → cutoff at the (1-1/rf) quantile
+        return float(np.quantile(vals, 1.0 - 1.0 / rf))
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous Successive Halving (reference:
+    tune/schedulers/async_hyperband.py AsyncHyperBandScheduler with
+    brackets=1, the recommended default)."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[_Rung] = []
+        m = grace_period
+        while m < max_t:
+            self.rungs.append(_Rung(m))
+            m = int(np.ceil(m * reduction_factor))
+        self.rungs.reverse()  # highest milestone first (match reference)
+
+    def on_result(self, trial, result, trials):
+        t = result.get(self.time_attr, trial.iteration)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        score = value if self.mode == "max" else -value
+        if t >= self.max_t:
+            return COMPLETE  # trial consumed its budget
+        decision = CONTINUE
+        for rung in self.rungs:
+            if t < rung.milestone or trial.trial_id in rung.recorded:
+                continue
+            cutoff = rung.cutoff(self.rf)
+            rung.recorded[trial.trial_id] = score
+            if cutoff is not None and score < cutoff:
+                decision = STOP
+            break  # only the highest applicable rung (async halving)
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result is worse than the median of other
+    trials' running means at the same iteration (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self._history: Dict[str, List[float]] = {}
+
+    def on_result(self, trial, result, trials):
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        score = value if self.mode == "max" else -value
+        self._history.setdefault(trial.trial_id, []).append(score)
+        t = result.get(self.time_attr, trial.iteration)
+        if t < self.grace_period:
+            return CONTINUE
+        means = [
+            float(np.mean(h))
+            for tid, h in self._history.items()
+            if tid != trial.trial_id
+        ]
+        if len(means) < self.min_samples:
+            return CONTINUE
+        best = max(self._history[trial.trial_id])
+        if best < float(np.median(means)):
+            return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): every
+    ``perturbation_interval`` iterations, bottom-quantile trials clone the
+    checkpoint of a top-quantile trial and perturb its hyperparameters."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        quantile_fraction: float = 0.25,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        seed: Optional[int] = None,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.quantile = quantile_fraction
+        self.mutations = hyperparam_mutations or {}
+        self.rng = np.random.default_rng(seed)
+        self.time_attr = time_attr
+
+    def _score(self, trial: Trial) -> Optional[float]:
+        v = trial.metric(self.metric)
+        if v is None:
+            return None
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial, result, trials):
+        t = result.get(self.time_attr, trial.iteration)
+        if t - trial.last_perturb_iter < self.interval:
+            return CONTINUE
+        trial.last_perturb_iter = t
+        scored: List[Tuple[float, Trial]] = []
+        for other in trials:
+            s = self._score(other)
+            if s is not None:
+                scored.append((s, other))
+        if len(scored) < 2:
+            return CONTINUE
+        scored.sort(key=lambda p: p[0])
+        k = max(1, int(len(scored) * self.quantile))
+        bottom = [p[1] for p in scored[:k]]
+        top = [p[1] for p in scored[-k:]]
+        if any(o.trial_id == trial.trial_id for o in bottom):
+            candidates = [
+                o for o in top
+                if o.trial_id != trial.trial_id and o.checkpoint_path
+            ]
+            if candidates:
+                source = candidates[
+                    int(self.rng.integers(len(candidates)))
+                ]
+                return (EXPLOIT, source)
+        return CONTINUE

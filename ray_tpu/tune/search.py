@@ -1,0 +1,176 @@
+"""Search spaces + variant generation.
+
+Reference: python/ray/tune/search/sample.py (Domain/Categorical/Float/
+Integer, grid_search) and tune/search/basic_variant.py
+(BasicVariantGenerator — grid cross-product x num_samples random draws).
+TPU-native redesign: plain-Python domains with a seeded numpy RNG; no
+external searcher deps (optuna/hyperopt are cloud-side concerns).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+    # PBT explore support: perturb a current value within the domain.
+    def perturb(self, value: Any, rng: np.random.Generator) -> Any:
+        return self.sample(rng)
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return self.categories[int(rng.integers(len(self.categories)))]
+
+    def perturb(self, value, rng):
+        # move to a neighboring category (reference pbt.py explore:
+        # resample from the distribution)
+        return self.sample(rng)
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        self.lower, self.upper, self.log = float(lower), float(upper), log
+
+    def sample(self, rng):
+        if self.log:
+            lo, hi = np.log(self.lower), np.log(self.upper)
+            return float(np.exp(rng.uniform(lo, hi)))
+        return float(rng.uniform(self.lower, self.upper))
+
+    def perturb(self, value, rng):
+        # reference pbt.py:explore — multiply by 0.8 or 1.2, clip
+        factor = 1.2 if rng.random() < 0.5 else 0.8
+        return float(np.clip(value * factor, self.lower, self.upper))
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = int(lower), int(upper)
+
+    def sample(self, rng):
+        return int(rng.integers(self.lower, self.upper))
+
+    def perturb(self, value, rng):
+        factor = 1.2 if rng.random() < 0.5 else 0.8
+        return int(np.clip(round(value * factor), self.lower,
+                           self.upper - 1))
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable[[], Any]):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn()
+
+
+# --- public constructors (match ray.tune names) -----------------------
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def sample_from(fn: Callable[[], Any]) -> Function:
+    return Function(fn)
+
+
+def grid_search(values) -> Dict[str, list]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _split_space(space: Dict[str, Any], prefix=()):
+    """Walk a (possibly nested) param space, yielding (path, spec)."""
+    for key, val in space.items():
+        path = prefix + (key,)
+        if isinstance(val, dict) and not _is_grid(val):
+            yield from _split_space(val, path)
+        else:
+            yield path, val
+
+
+def _set_path(cfg: dict, path, value):
+    node = cfg
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def generate_variants(
+    param_space: Dict[str, Any],
+    num_samples: int = 1,
+    seed: Optional[int] = None,
+) -> Iterator[Dict[str, Any]]:
+    """Reference: BasicVariantGenerator semantics — the full grid
+    cross-product is repeated ``num_samples`` times, with random domains
+    re-drawn per variant."""
+    rng = np.random.default_rng(seed)
+    entries = list(_split_space(param_space))
+    grid_paths = [(p, v["grid_search"]) for p, v in entries if _is_grid(v)]
+    grids = [vals for _, vals in grid_paths] or [[None]]
+
+    for _ in range(num_samples):
+        for combo in itertools.product(*grids):
+            cfg: Dict[str, Any] = {}
+            for path, spec in entries:
+                if _is_grid(spec):
+                    continue
+                if isinstance(spec, Domain):
+                    _set_path(cfg, path, spec.sample(rng))
+                else:
+                    _set_path(cfg, path, spec)
+            if grid_paths:
+                for (path, _), val in zip(grid_paths, combo):
+                    _set_path(cfg, path, val)
+            yield cfg
+
+
+def perturb_config(
+    config: Dict[str, Any],
+    param_space: Dict[str, Any],
+    rng: np.random.Generator,
+) -> Dict[str, Any]:
+    """PBT explore step: perturb every Domain-valued hyperparameter
+    (reference: tune/schedulers/pbt.py _explore)."""
+    import copy
+
+    # deep copy: perturbing a nested key must not mutate the source
+    # trial's config
+    new = copy.deepcopy(config)
+    for path, spec in _split_space(param_space):
+        if isinstance(spec, Domain):
+            node = new
+            ok = True
+            for key in path[:-1]:
+                node = node.get(key)
+                if not isinstance(node, dict):
+                    ok = False
+                    break
+            if ok and path[-1] in node:
+                node[path[-1]] = spec.perturb(node[path[-1]], rng)
+    return new

@@ -1,0 +1,66 @@
+"""Trial state machine.
+
+Reference: python/ray/tune/experiment/trial.py (Trial) — pared to the
+fields the controller and schedulers actually use, JSON-serializable for
+experiment checkpoint/resume.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Dict[str, Any] = field(default_factory=dict)
+    iteration: int = 0
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    num_failures: int = 0
+    start_time: float = 0.0
+    stopped_early: bool = False
+    # PBT bookkeeping
+    last_perturb_iter: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status,
+            "last_result": self.last_result,
+            "iteration": self.iteration,
+            "checkpoint_path": self.checkpoint_path,
+            "error": self.error,
+            "num_failures": self.num_failures,
+            "stopped_early": self.stopped_early,
+            "last_perturb_iter": self.last_perturb_iter,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Trial":
+        t = cls(trial_id=d["trial_id"], config=d["config"])
+        t.status = d.get("status", PENDING)
+        t.last_result = d.get("last_result", {})
+        t.iteration = d.get("iteration", 0)
+        t.checkpoint_path = d.get("checkpoint_path")
+        t.error = d.get("error")
+        t.num_failures = d.get("num_failures", 0)
+        t.stopped_early = d.get("stopped_early", False)
+        t.last_perturb_iter = d.get("last_perturb_iter", 0)
+        return t
+
+    def is_finished(self) -> bool:
+        return self.status in (TERMINATED, ERROR)
+
+    def metric(self, name: str, default=None):
+        return self.last_result.get(name, default)

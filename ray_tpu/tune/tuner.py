@@ -1,0 +1,489 @@
+"""Tuner + trial controller.
+
+Reference call stack: Tuner.fit (python/ray/tune/tuner.py:312) →
+TuneController event loop (tune/execution/tune_controller.py:68) driving
+one actor per trial, feeding results to a TrialScheduler, checkpointing
+experiment state for Tuner.restore.
+
+TPU-native shape: the controller is a driver-side loop (fit() blocks);
+each trial is one actor whose trainable runs on a thread and reports
+through a polled mailbox — the same gang pattern as train/api.py. Trials
+are the unit of placement: resources per trial map to actor resources, so
+a TPU trial occupies a whole host slice and the cluster caps concurrency.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..train.api import RunConfig
+from ..train.checkpoint import Checkpoint
+from . import schedulers as sched_mod
+from . import search as search_mod
+from .schedulers import (
+    COMPLETE,
+    CONTINUE,
+    EXPLOIT,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from .trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
+
+# ---------------------------------------------------------------------------
+# trainable-side session (reference: ray.tune.report / get_checkpoint)
+# ---------------------------------------------------------------------------
+
+
+class _Session:
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 checkpoint: Optional[Checkpoint], workdir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.checkpoint = checkpoint
+        self.workdir = workdir
+        self.iteration = 0
+        self.reports: List[dict] = []
+        self.lock = threading.Lock()
+
+
+_session: Optional[_Session] = None
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from a trainable."""
+    s = _session
+    if s is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    with s.lock:
+        s.iteration += 1
+        m = dict(metrics)
+        m.setdefault("training_iteration", s.iteration)
+        s.reports.append(
+            {
+                "metrics": m,
+                "checkpoint_path": checkpoint.path if checkpoint else None,
+            }
+        )
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _session
+    return s.checkpoint if s else None
+
+
+def get_trial_id() -> str:
+    s = _session
+    return s.trial_id if s else ""
+
+
+def get_trial_dir() -> str:
+    s = _session
+    return s.workdir if s else ""
+
+
+class _TrialActor:
+    """Runs one trial's trainable on a thread; controller polls."""
+
+    def __init__(self, trial_id: str, workdir: str):
+        self.trial_id = trial_id
+        self.workdir = workdir
+        self._done = False
+        self._error: Optional[str] = None
+
+    def run(self, payload: bytes, config: Dict[str, Any],
+            checkpoint_path: Optional[str],
+            start_iteration: int = 0) -> bool:
+        import cloudpickle
+
+        trainable = cloudpickle.loads(payload)
+        global _session
+        _session = _Session(
+            self.trial_id, config,
+            Checkpoint(checkpoint_path) if checkpoint_path else None,
+            self.workdir,
+        )
+        # training_iteration counts cumulatively across restarts
+        # (reference: Trainable keeps _iteration in restored state).
+        _session.iteration = start_iteration
+        self._s = _session
+
+        def target():
+            try:
+                trainable(config)
+            except Exception:
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=target, daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> Dict[str, Any]:
+        with self._s.lock:
+            reports, self._s.reports = self._s.reports, []
+        return {"done": self._done, "error": self._error,
+                "reports": reports}
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TuneConfig:
+    """Reference: ray.tune.TuneConfig."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    max_failures_per_trial: int = 0
+    trial_resources: Dict[str, float] = field(
+        default_factory=lambda: {"CPU": 1}
+    )
+
+
+def with_resources(trainable: Callable, resources: Dict[str, float]):
+    """Reference: tune.with_resources."""
+    trainable._tune_resources = dict(resources)  # type: ignore
+    return trainable
+
+
+class TuneError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# results
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    path: str
+
+    @property
+    def metrics_dataframe(self):  # pragma: no cover - convenience
+        import pandas as pd
+
+        hist_file = os.path.join(self.path, "result.jsonl")
+        rows = []
+        if os.path.exists(hist_file):
+            with open(hist_file) as f:
+                rows = [json.loads(line) for line in f]
+        return pd.DataFrame(rows)
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], experiment_path: str):
+        self._results = results
+        self.experiment_path = experiment_path
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> TrialResult:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or getattr(self, "_metric", None)
+        mode = mode or getattr(self, "_mode", "max")
+        scored = [r for r in self._results
+                  if r.metrics.get(metric) is not None]
+        if not scored:
+            raise TuneError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored,
+                                                              key=key)
+
+
+# ---------------------------------------------------------------------------
+# Tuner / controller
+# ---------------------------------------------------------------------------
+
+
+class Tuner:
+    """Reference: ray.tune.Tuner (tuner.py:43). fit() runs the trial
+    event loop; restore() resumes an interrupted experiment."""
+
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored_trials: Optional[List[Trial]] = None
+
+    # -- experiment persistence ---------------------------------------
+    @classmethod
+    def restore(cls, experiment_path: str, trainable: Callable) -> "Tuner":
+        state_file = os.path.join(experiment_path, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        # param_space (may contain Domain objects) rides a pickle sidecar
+        param_space = {}
+        ps_file = os.path.join(experiment_path, "param_space.pkl")
+        if os.path.exists(ps_file):
+            import cloudpickle
+
+            with open(ps_file, "rb") as f:
+                param_space = cloudpickle.load(f)
+        tuner = cls(
+            trainable,
+            param_space=param_space,
+            tune_config=TuneConfig(
+                metric=state["metric"],
+                mode=state["mode"],
+                num_samples=state.get("num_samples", 1),
+                max_failures_per_trial=state.get(
+                    "max_failures_per_trial", 0),
+                trial_resources=state.get("trial_resources", {"CPU": 1}),
+            ),
+            run_config=RunConfig(
+                name=os.path.basename(experiment_path.rstrip("/")),
+                storage_path=os.path.dirname(
+                    experiment_path.rstrip("/")) or ".",
+            ),
+        )
+        trials = [Trial.from_json(t) for t in state["trials"]]
+        for t in trials:
+            if not t.is_finished():
+                t.status = PENDING  # re-run from last checkpoint
+        tuner._restored_trials = trials
+        return tuner
+
+    def _experiment_dir(self) -> str:
+        name = self.run_config.name or f"tune_{int(time.time())}"
+        path = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> ResultGrid:
+        import cloudpickle
+
+        import ray_tpu as ray
+
+        tc = self.tune_config
+        exp_dir = self._experiment_dir()
+        scheduler = tc.scheduler or FIFOScheduler()
+        if getattr(scheduler, "metric", None) is None and tc.metric:
+            scheduler.metric = tc.metric
+            scheduler.mode = tc.mode
+        payload = cloudpickle.dumps(self.trainable)
+        resources = getattr(self.trainable, "_tune_resources",
+                            tc.trial_resources)
+
+        # --- build / restore trial set -------------------------------
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            trials = [
+                Trial(trial_id=f"t{i:05d}_{uuid.uuid4().hex[:6]}",
+                      config=cfg)
+                for i, cfg in enumerate(
+                    search_mod.generate_variants(
+                        self.param_space, tc.num_samples, tc.seed))
+            ]
+        for t in trials:
+            scheduler.on_trial_add(t)
+
+        max_concurrent = tc.max_concurrent_trials or max(
+            1, len(trials))
+        actors: Dict[str, Any] = {}
+        import numpy as np
+
+        rng = np.random.default_rng(tc.seed)
+        with open(os.path.join(exp_dir, "param_space.pkl"), "wb") as f:
+            cloudpickle.dump(self.param_space, f)
+
+        def persist():
+            state = {
+                "metric": tc.metric,
+                "mode": tc.mode,
+                "num_samples": tc.num_samples,
+                "max_failures_per_trial": tc.max_failures_per_trial,
+                "trial_resources": resources,
+                "trials": [t.to_json() for t in trials],
+            }
+            tmp = os.path.join(exp_dir, ".state_tmp")
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, os.path.join(exp_dir,
+                                         "experiment_state.json"))
+
+        def trial_dir(t: Trial) -> str:
+            d = os.path.join(exp_dir, t.trial_id)
+            os.makedirs(d, exist_ok=True)
+            return d
+
+        def actor_options() -> dict:
+            opts: Dict[str, Any] = {"max_restarts": 0}
+            for key, val in resources.items():
+                if key == "CPU":
+                    opts["num_cpus"] = val
+                elif key == "TPU":
+                    opts["num_tpus"] = val
+                else:
+                    opts.setdefault("resources", {})[key] = val
+            return opts
+
+        ActorCls = ray.remote(_TrialActor)
+
+        def start_trial(t: Trial):
+            a = ActorCls.options(**actor_options()).remote(
+                t.trial_id, trial_dir(t))
+            a.run.remote(payload, t.config, t.checkpoint_path,
+                         t.iteration)
+            actors[t.trial_id] = a
+            t.status = RUNNING
+            t.start_time = time.time()
+
+        def stop_actor(t: Trial):
+            a = actors.pop(t.trial_id, None)
+            if a is not None:
+                try:
+                    ray.kill(a)
+                except Exception:
+                    pass
+
+        def save_trial_checkpoint(t: Trial, src_path: str) -> str:
+            dest = os.path.join(trial_dir(t),
+                                f"checkpoint_{t.iteration:06d}")
+            if os.path.abspath(src_path) != dest:
+                shutil.copytree(src_path, dest, dirs_exist_ok=True)
+            return dest
+
+        def append_history(t: Trial, metrics: dict):
+            with open(os.path.join(trial_dir(t), "result.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(metrics) + "\n")
+
+        def handle_failure(t: Trial, err: str):
+            stop_actor(t)
+            t.num_failures += 1
+            if t.num_failures <= tc.max_failures_per_trial:
+                t.status = PENDING  # retry from last checkpoint
+            else:
+                t.status = ERROR
+                t.error = err
+                scheduler.on_trial_complete(t)
+
+        # --- event loop ----------------------------------------------
+        persist()
+        try:
+            while any(not t.is_finished() for t in trials):
+                # launch pending trials up to the concurrency cap
+                running = [t for t in trials if t.status == RUNNING]
+                for t in trials:
+                    if (t.status == PENDING
+                            and len(running) < max_concurrent):
+                        start_trial(t)
+                        running.append(t)
+                dirty = False
+                for t in list(running):
+                    a = actors.get(t.trial_id)
+                    if a is None:
+                        continue
+                    try:
+                        p = ray.get(a.poll.remote(), timeout=60)
+                    except ray.RayError as e:
+                        handle_failure(t, f"trial actor died: {e}")
+                        dirty = True
+                        continue
+                    decision = CONTINUE
+                    for rep in p["reports"]:
+                        t.iteration = rep["metrics"].get(
+                            "training_iteration", t.iteration + 1)
+                        t.last_result = rep["metrics"]
+                        append_history(t, rep["metrics"])
+                        if rep["checkpoint_path"]:
+                            t.checkpoint_path = save_trial_checkpoint(
+                                t, rep["checkpoint_path"])
+                        decision = scheduler.on_result(
+                            t, rep["metrics"], trials)
+                        dirty = True
+                        if decision != CONTINUE:
+                            break
+                    if isinstance(decision, tuple) and \
+                            decision[0] == EXPLOIT:
+                        source = decision[1]
+                        stop_actor(t)
+                        if source.checkpoint_path:
+                            t.checkpoint_path = save_trial_checkpoint(
+                                t, source.checkpoint_path)
+                        t.config = search_mod.perturb_config(
+                            source.config, self.param_space, rng)
+                        t.status = PENDING  # restart exploited trial
+                        dirty = True
+                        continue
+                    if decision in (STOP, COMPLETE):
+                        stop_actor(t)
+                        t.status = TERMINATED
+                        t.stopped_early = decision == STOP
+                        scheduler.on_trial_complete(t)
+                        dirty = True
+                        continue
+                    if p["error"]:
+                        handle_failure(t, p["error"])
+                        dirty = True
+                    elif p["done"]:
+                        stop_actor(t)
+                        t.status = TERMINATED
+                        scheduler.on_trial_complete(t)
+                        dirty = True
+                if dirty:
+                    persist()
+                time.sleep(0.05)
+        finally:
+            for t in trials:
+                stop_actor(t)
+            persist()
+
+        results = [
+            TrialResult(
+                trial_id=t.trial_id,
+                config=t.config,
+                metrics=t.last_result,
+                checkpoint=Checkpoint(t.checkpoint_path)
+                if t.checkpoint_path else None,
+                error=t.error,
+                path=os.path.join(exp_dir, t.trial_id),
+            )
+            for t in trials
+        ]
+        grid = ResultGrid(results, exp_dir)
+        grid._metric = tc.metric
+        grid._mode = tc.mode
+        return grid
