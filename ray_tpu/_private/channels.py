@@ -1,0 +1,79 @@
+"""Static channels: bounded worker-to-worker mailboxes.
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py
+(mutable plasma objects with reader/writer rendezvous) and
+channel/communicator.py:18 (the Communicator ABC NCCL channels implement).
+
+TPU-native redesign: a channel is a bounded asyncio mailbox homed on the
+*consumer's* worker; producers push into it over a persistent RPC
+connection (or a direct local enqueue when co-located). Bounded depth
+gives the same backpressure the reference gets from its single mutable
+buffer, while depth > 1 pipelines successive DAG executions.
+
+Payload kinds:
+- ("v", bytes)   — serialized value
+- ("dev", bytes) — serialized DeviceObjectMeta (payload stays in the
+                   producer's device memory; resolved lazily on read)
+- ("err", bytes) — serialized exception, propagated to the DAG output
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Optional, Tuple
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelManager:
+    """Per-worker registry of consumer-side mailboxes."""
+
+    def __init__(self, worker, default_depth: int = 2):
+        self._worker = worker
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._closed: set = set()
+        self._default_depth = default_depth
+
+    def ensure(self, channel_id: str, depth: Optional[int] = None):
+        if channel_id not in self._queues:
+            self._queues[channel_id] = asyncio.Queue(
+                maxsize=depth or self._default_depth
+            )
+            self._closed.discard(channel_id)
+        return self._queues[channel_id]
+
+    async def push_local(self, channel_id: str, item: Tuple[str, Any]):
+        if channel_id in self._closed:
+            raise ChannelClosed(channel_id)
+        await self.ensure(channel_id).put(item)
+
+    async def read(self, channel_id: str) -> Tuple[str, Any]:
+        if channel_id in self._closed:
+            raise ChannelClosed(channel_id)
+        return await self.ensure(channel_id).get()
+
+    def close(self, channel_id: str):
+        self._closed.add(channel_id)
+        q = self._queues.pop(channel_id, None)
+        if q is not None:
+            # wake blocked readers with a poison pill
+            try:
+                q.put_nowait(("closed", None))
+            except Exception:
+                pass
+
+    def close_all(self, prefix: str = ""):
+        for cid in [c for c in self._queues if c.startswith(prefix)]:
+            self.close(cid)
+
+    async def push_remote(self, address: Tuple[str, int], channel_id: str,
+                          item: Tuple[str, Any]):
+        """Push into a mailbox homed on another worker (or locally when
+        the address is ours) — blocks while the mailbox is full."""
+        if tuple(address) == tuple(self._worker.address):
+            await self.push_local(channel_id, item)
+            return
+        cli = self._worker._pool.get(*address)
+        await cli.call("channel_push", channel_id=channel_id,
+                       kind=item[0], payload=item[1])

@@ -31,9 +31,11 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from . import serialization
+from .channels import ChannelClosed, ChannelManager
 from .config import get_config
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .object_store import ObjectStoreFullError, ShmClient
+from ..experimental.device_objects import DeviceObjectMeta, DeviceObjectStore
 from .rpc import (
     ClientPool,
     EventLoopThread,
@@ -376,6 +378,19 @@ class CoreWorker:
         self._task_events: List[dict] = []
         self._task_events_lock = threading.Lock()
 
+        # device-resident objects (RDT analogue) + static DAG channels
+        self.device_store = DeviceObjectStore(
+            cache_bytes=getattr(self._cfg, "device_object_cache_bytes",
+                                1 << 30)
+        )
+        self.channels = ChannelManager(self)
+        # oid -> [remaining DAG consumers, dag_id] before the primary
+        # copy is freed
+        self._dag_dev_pending: Dict[bytes, list] = {}
+        self._dag_dev_lock = threading.Lock()
+        # dag_id -> [asyncio.Task] resident node loops on this worker
+        self._dag_tasks: Dict[str, list] = {}
+
     # ------------------------------------------------------------------
     def start(self):
         loop = EventLoopThread.get()
@@ -444,6 +459,15 @@ class CoreWorker:
         s.register_method("exit_worker", self._rpc_exit_worker)
         s.register_method("cancel_task", self._rpc_cancel_task)
         s.register_method("ping", self._rpc_ping)
+        # device objects + compiled-DAG channels
+        s.register_method("fetch_device_object",
+                          self._rpc_fetch_device_object)
+        s.register_method("free_device_object",
+                          self._rpc_free_device_object)
+        s.register_method("channel_push", self._rpc_channel_push)
+        s.register_method("dag_install", self._rpc_dag_install)
+        s.register_method("dag_teardown", self._rpc_dag_teardown)
+        s.register_method("dag_dev_consumed", self._rpc_dag_dev_consumed)
 
     async def _rpc_ping(self):
         return "pong"
@@ -546,6 +570,11 @@ class CoreWorker:
         return rem
 
     def _get_one(self, ref: ObjectRef, deadline):
+        # device markers resolve to live pytrees transparently
+        # (reference: RDT refs materialize tensors on ray.get)
+        return self._maybe_resolve_device(self._get_one_inner(ref, deadline))
+
+    def _get_one_inner(self, ref: ObjectRef, deadline):
         oid = ref.id
         # 1. in-process memory store
         if self.memory_store.contains(oid):
@@ -894,6 +923,7 @@ class CoreWorker:
 
     def _free_now(self, oid: ObjectID, rec: _ObjectRecord):
         self._records.pop(oid.binary(), None)
+        self._maybe_free_device(oid)
         self.memory_store.delete(oid)
         if rec.locations:
             EventLoopThread.get().spawn(
@@ -909,6 +939,7 @@ class CoreWorker:
             if rec.local_refs > 0 or rec.borrowers > 0 or rec.pending:
                 return  # resurrected by a late borrower
             self._records.pop(oid.binary(), None)
+        self._maybe_free_device(oid)
         self.memory_store.delete(oid)
         if rec.locations:
             await self._free_shm_copies(oid.binary(), set(rec.locations))
@@ -972,6 +1003,7 @@ class CoreWorker:
         name: str = "",
         serialized_func: Optional[bytes] = None,
         func_refs: Sequence["ObjectRef"] = (),
+        tensor_transport: Optional[str] = None,
     ) -> List[ObjectRef]:
         self._task_counter += 1
         task_id = TaskID.for_job(self.job_id)
@@ -1000,6 +1032,8 @@ class CoreWorker:
             "strategy_params": strategy_params or {},
             "owner_address": list(self.address),
         }
+        if tensor_transport:
+            spec["tensor_transport"] = tensor_transport
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
@@ -1279,6 +1313,7 @@ class CoreWorker:
         *,
         num_returns: int = 1,
         max_task_retries: int = 0,
+        tensor_transport: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = TaskID.for_job(self.job_id)
         return_ids = [
@@ -1297,6 +1332,8 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_address": list(self.address),
         }
+        if tensor_transport:
+            spec["tensor_transport"] = tensor_transport
         for r in arg_refs:
             self._retain_ref(r.id, r.owner_address)
         with self._records_lock:
@@ -1371,6 +1408,17 @@ class CoreWorker:
                     f"{len(values)}"
                 )
         out = []
+        if spec.get("tensor_transport") == "device":
+            # value stays in this worker's device memory; only the marker
+            # travels (reference: gpu_object_manager keeps tensors on-GPU
+            # and ships metadata through plasma)
+            for i, value in enumerate(values):
+                oid = ObjectID.for_task_return(task_id, i)
+                out.append(
+                    (oid.binary(), "inline",
+                     self._store_device_return(oid, value))
+                )
+            return out
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(task_id, i)
             meta, buffers = serialization.serialize(value)
@@ -1567,6 +1615,330 @@ class CoreWorker:
 
     async def _rpc_cancel_task(self, task_id: bytes):
         return False  # cooperative cancellation lands with generators
+
+    # ==================================================================
+    # device-resident objects (reference: gpu_object_manager.py:50)
+    # ==================================================================
+    def _store_device_return(self, oid: ObjectID, value) -> bytes:
+        """Pin a return value in this worker's device memory; produce the
+        serialized DeviceObjectMeta marker that rides the normal path."""
+        from ..experimental import device_objects as devobj
+
+        self.device_store.put_primary(oid.binary(), value)
+        meta = DeviceObjectMeta(
+            oid.binary(), self.address, self.node_id,
+            devobj.tree_nbytes(value), devobj.tree_summary(value),
+        )
+        return serialization.dumps(meta)
+
+    def _resolve_device_object(self, meta: DeviceObjectMeta,
+                               dag_edge: bool = False):
+        """Marker → live pytree. Three transports, fastest physical path
+        per topology (the TPU answer to RDT's NCCL channel selection):
+
+        - same process: zero-copy handoff from the device store;
+        - same node: producer stages the payload once in the node's shm
+          arena (device_get → shm), consumer maps it zero-copy and
+          device_puts — two copies total, no sockets, no driver;
+        - cross node: direct worker-to-worker socket (DCN plane),
+          bypassing raylet chunked pull.
+
+        The owner/driver never carries the payload either way — only the
+        marker rides the object table. Called from executor/driver
+        threads only (blocking RPC)."""
+        from ..experimental import device_objects as devobj
+
+        if tuple(meta.producer_address) == self.address:
+            val = self.device_store.get_primary(meta.oid)
+            if val is not None:
+                if dag_edge:
+                    self._dag_dev_consumed(meta.oid)
+                return val
+        if not dag_edge:
+            # DAG edge oids are random per execution — caching them would
+            # only pollute the LRU and skew consumer accounting
+            cached = self.device_store.cache_get(meta.oid)
+            if cached is not None:
+                return cached
+        same_node = meta.producer_node == self.node_id
+        try:
+            cli = self._pool.get(*meta.producer_address)
+            payload = cli.call_sync(
+                "fetch_device_object", object_id=meta.oid,
+                via_shm=same_node, timeout=120.0,
+            )
+        except (RpcConnectionError, TimeoutError) as e:
+            raise ObjectLostError(
+                f"device object ({meta.summary}) lost: producer at "
+                f"{meta.producer_address} unreachable: {e}"
+            ) from None
+        if payload is None:
+            raise ObjectLostError(
+                f"device object ({meta.summary}) was freed at the producer"
+            )
+        if payload == "shm":
+            oid = ObjectID(meta.oid)
+            buf = self.store.get_buffer(oid)
+            if buf is None:
+                raise ObjectLostError(
+                    f"device object ({meta.summary}): staged shm copy "
+                    f"missing"
+                )
+            host = serialization.loads_from(buf)
+            value = devobj.device_put_tree(host)
+            if dag_edge:
+                # ack AFTER the staged buffer is fully consumed — the
+                # producer must not free it while we read (the socket
+                # path has no such window: the reply carries the bytes)
+                self._notify_dev_consumed(meta)
+        else:
+            value = devobj.from_wire(payload)
+        if not dag_edge:
+            self.device_store.cache_put(meta.oid, value, meta.nbytes)
+        return value
+
+    def _notify_dev_consumed(self, meta: DeviceObjectMeta):
+        """Tell the producer one DAG consumer is done with a payload."""
+        if tuple(meta.producer_address) == self.address:
+            self._dag_dev_consumed(meta.oid)
+            return
+        try:
+            cli = self._pool.get(*meta.producer_address)
+            EventLoopThread.get().spawn(
+                cli.call("dag_dev_consumed", object_id=meta.oid)
+            )
+        except Exception:
+            pass
+
+    def _maybe_resolve_device(self, value):
+        if isinstance(value, DeviceObjectMeta):
+            return self._resolve_device_object(value)
+        return value
+
+    def _maybe_free_device(self, oid: ObjectID):
+        """Owner-side hook: when an object's refcount hits zero and its
+        value is a device marker, release the producer's HBM pin."""
+        if not self.memory_store.contains(oid):
+            return
+        try:
+            v = self.memory_store.get(oid)
+        except KeyError:
+            return
+        if isinstance(v, DeviceObjectMeta):
+            try:
+                cli = self._pool.get(*v.producer_address)
+                EventLoopThread.get().spawn(
+                    cli.call("free_device_object", object_id=v.oid)
+                )
+            except Exception:
+                pass
+
+    def _dag_dev_consumed(self, oid: bytes):
+        """Decrement a DAG edge payload's remaining-consumer count; free
+        the producer pin when every consumer has taken it."""
+        with self._dag_dev_lock:
+            ent = self._dag_dev_pending.get(oid)
+            if ent is None:
+                return
+            ent[0] -= 1
+            if ent[0] <= 0:
+                self._dag_dev_pending.pop(oid, None)
+                self.device_store.free_primary(oid)
+                try:
+                    self.store.delete(ObjectID(oid))
+                except Exception:
+                    pass
+
+    async def _rpc_fetch_device_object(self, object_id: bytes,
+                                       via_shm: bool = False):
+        from ..experimental import device_objects as devobj
+
+        val = self.device_store.get_primary(object_id)
+        if val is None:
+            return None
+        loop = asyncio.get_running_loop()
+        if via_shm:
+            # stage once in the node-local arena; concurrent fetches of
+            # the same object reuse the staged copy. The consumer acks
+            # via dag_dev_consumed after reading — decrementing here
+            # would let the last fetch free the buffer under an earlier
+            # fetcher still mapping it.
+            await loop.run_in_executor(
+                self._task_executor, self._stage_device_shm,
+                object_id, val,
+            )
+            return "shm"
+        payload = await loop.run_in_executor(
+            self._task_executor, devobj.to_wire, val
+        )
+        self._dag_dev_consumed(object_id)
+        return payload
+
+    def _stage_device_shm(self, object_id: bytes, val):
+        import numpy as np
+
+        oid = ObjectID(object_id)
+        with self._dag_dev_lock:
+            if self.store.contains(oid):
+                return
+        import jax
+
+        host = jax.tree_util.tree_map(np.asarray, val)
+        meta, buffers = serialization.serialize(host)
+        size = serialization.serialized_size(meta, buffers)
+        try:
+            self._write_shm(oid, meta, buffers, size)
+        except Exception:
+            # lost the stage race with a concurrent fetch — fine
+            if not self.store.contains(oid):
+                raise
+
+    async def _rpc_dag_dev_consumed(self, object_id: bytes):
+        self._dag_dev_consumed(object_id)
+        return True
+
+    async def _rpc_free_device_object(self, object_id: bytes):
+        with self._dag_dev_lock:
+            self._dag_dev_pending.pop(object_id, None)
+        self.device_store.free_primary(object_id)
+        try:
+            # drop the staged shm copy, if any (store keeps it alive for
+            # readers still holding mapped views)
+            self.store.delete(ObjectID(object_id))
+        except Exception:
+            pass
+        return True
+
+    # ==================================================================
+    # compiled-DAG channels (reference: dag/compiled_dag_node.py:809)
+    # ==================================================================
+    async def _rpc_channel_push(self, channel_id: str, kind: str,
+                                payload):
+        await self.channels.push_local(channel_id, (kind, payload))
+        return True
+
+    async def _rpc_dag_install(self, spec: dict):
+        """Install a resident node loop: await input channels, run the
+        actor method, push results directly to consumer workers."""
+        for src in spec["args"]:
+            if src[0] == "chan":
+                self.channels.ensure(src[1], spec.get("depth", 2))
+        task = asyncio.ensure_future(self._dag_node_loop(spec))
+        self._dag_tasks.setdefault(spec["dag_id"], []).append(task)
+        return True
+
+    async def _rpc_dag_teardown(self, dag_id: str):
+        for task in self._dag_tasks.pop(dag_id, []):
+            task.cancel()
+        self.channels.close_all(dag_id)
+        # free only THIS dag's still-pinned device payloads — other live
+        # DAGs sharing this actor keep theirs
+        with self._dag_dev_lock:
+            stale = [o for o, ent in self._dag_dev_pending.items()
+                     if ent[1] == dag_id]
+            for o in stale:
+                self._dag_dev_pending.pop(o, None)
+                self.device_store.free_primary(o)
+        return True
+
+    def decode_channel_item(self, kind: str, payload):
+        if kind == "v":
+            return serialization.loads(payload)
+        if kind == "dev":
+            return self._resolve_device_object(
+                serialization.loads(payload), dag_edge=True
+            )
+        raise ValueError(f"unknown channel payload kind {kind!r}")
+
+    def _encode_channel_item(self, value, tensor_transport,
+                             num_consumers: int, dag_id: str = ""):
+        if tensor_transport == "device":
+            oid = ObjectID.from_random()
+            with self._dag_dev_lock:
+                self._dag_dev_pending[oid.binary()] = [num_consumers,
+                                                       dag_id]
+            payload = self._store_device_return(oid, value)
+            return ("dev", payload)
+        return ("v", serialization.dumps(value))
+
+    def _release_dev_items(self, raw_items: List[tuple]):
+        """Release producer pins of 'dev' items we will not decode (error
+        short-circuit / shutdown) so upstream HBM is not leaked."""
+        for k, p in raw_items:
+            if k == "dev":
+                try:
+                    self._notify_dev_consumed(serialization.loads(p))
+                except Exception:
+                    pass
+
+    async def _dag_node_loop(self, spec: dict):
+        chans = self.channels
+        outs = [(tuple(addr), cid) for addr, cid in spec["outs"]]
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                raw_items: List[tuple] = []
+                err_payload = None
+                for src in spec["args"]:
+                    if src[0] == "chan":
+                        kind, payload = await chans.read(src[1])
+                        if kind == "closed":
+                            self._release_dev_items(raw_items)
+                            return
+                        if kind == "err":
+                            err_payload = err_payload or payload
+                        raw_items.append((kind, payload))
+                    else:
+                        raw_items.append(("lit", src[1]))
+                if err_payload is not None:
+                    # inputs that did arrive as device payloads must
+                    # still be released at their producers
+                    self._release_dev_items(raw_items)
+                    item = ("err", err_payload)
+                else:
+                    def run():
+                        vals = [
+                            serialization.loads(p) if k == "lit"
+                            else self.decode_channel_item(k, p)
+                            for k, p in raw_items
+                        ]
+                        method = getattr(self.actor_instance,
+                                         spec["method"])
+                        return method(*vals)
+
+                    try:
+                        result = await loop.run_in_executor(
+                            self._actor_executor or self._task_executor,
+                            run,
+                        )
+                        item = await loop.run_in_executor(
+                            self._task_executor,
+                            self._encode_channel_item,
+                            result, spec.get("tensor_transport"),
+                            len(outs), spec["dag_id"],
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        tb = traceback.format_exc()
+                        item = ("err", serialization.dumps(RayTaskError(
+                            f"{type(e).__name__}: {e}\n{tb}",
+                            type(e).__name__,
+                        )))
+                for addr, cid in outs:
+                    try:
+                        await chans.push_remote(addr, cid, item)
+                    except (asyncio.CancelledError, ChannelClosed):
+                        raise
+                    except Exception as e:  # consumer worker gone
+                        # keep the loop alive: other consumers and later
+                        # executions may still be healthy
+                        print(
+                            f"[ray_tpu] dag {spec['dag_id']} node "
+                            f"{spec['node_id']}: push to {addr} failed: "
+                            f"{e}",
+                            flush=True,
+                        )
+        except (asyncio.CancelledError, ChannelClosed):
+            return
 
     # ==================================================================
     # task events (observability; flushed to GCS task-event store)

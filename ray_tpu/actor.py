@@ -18,22 +18,36 @@ from .remote_function import _demand_from_options, _strategy_from_options
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str,
+                 num_returns: int = 1,
+                 tensor_transport: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._tensor_transport = tensor_transport
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
-            self._name, args, kwargs, num_returns=self._num_returns
+            self._name, args, kwargs, num_returns=self._num_returns,
+            tensor_transport=self._tensor_transport,
         )
 
-    def options(self, num_returns: Optional[int] = None):
+    def options(self, num_returns: Optional[int] = None,
+                tensor_transport: Optional[str] = "__unset__"):
         return ActorMethod(
             self._handle,
             self._name,
             self._num_returns if num_returns is None else num_returns,
+            self._tensor_transport if tensor_transport == "__unset__"
+            else tensor_transport,
         )
+
+    def bind(self, *args):
+        """Build a static-DAG node (reference: dag/class_node.py bind)."""
+        from .dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args,
+                               tensor_transport=self._tensor_transport)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -56,10 +70,17 @@ class ActorHandle:
     def __getattr__(self, name: str):
         methods = object.__getattribute__(self, "_methods")
         if name in methods:
-            return ActorMethod(self, name, methods[name])
+            m = methods[name]
+            if isinstance(m, dict):
+                return ActorMethod(
+                    self, name, m.get("num_returns", 1),
+                    m.get("tensor_transport"),
+                )
+            return ActorMethod(self, name, m)
         raise AttributeError(f"actor has no method {name!r}")
 
-    def _actor_method_call(self, method_name, args, kwargs, num_returns=1):
+    def _actor_method_call(self, method_name, args, kwargs, num_returns=1,
+                           tensor_transport=None):
         worker = global_worker()
         refs = worker.submit_actor_task(
             self._actor_id,
@@ -68,6 +89,7 @@ class ActorHandle:
             kwargs,
             num_returns=num_returns,
             max_task_retries=self._max_task_retries,
+            tensor_transport=tensor_transport,
         )
         if num_returns == 1:
             return refs[0]
@@ -87,22 +109,31 @@ class ActorHandle:
         return self._actor_id
 
 
-def _public_methods(cls) -> Dict[str, int]:
-    methods: Dict[str, int] = {}
+def _public_methods(cls) -> Dict[str, Any]:
+    methods: Dict[str, Any] = {}
     for name, fn in inspect.getmembers(cls, predicate=callable):
         if name.startswith("__") and name != "__call__":
             continue
         num_returns = getattr(fn, "_ray_num_returns", 1)
-        methods[name] = num_returns
+        transport = getattr(fn, "_ray_tensor_transport", None)
+        if transport:
+            methods[name] = {"num_returns": num_returns,
+                             "tensor_transport": transport}
+        else:
+            methods[name] = num_returns
     return methods
 
 
-def method(num_returns: int = 1):
-    """@ray_tpu.method(num_returns=N) on actor methods (reference:
-    python/ray/actor.py `method` decorator)."""
+def method(num_returns: int = 1, tensor_transport: Optional[str] = None):
+    """@ray_tpu.method(num_returns=N, tensor_transport="device") on actor
+    methods (reference: python/ray/actor.py `method` decorator;
+    tensor_transport mirrors the RDT `@ray.method(tensor_transport=...)`
+    option — returns stay in the producer's device memory)."""
 
     def decorator(fn):
         fn._ray_num_returns = num_returns
+        if tensor_transport:
+            fn._ray_tensor_transport = tensor_transport
         return fn
 
     return decorator

@@ -1,0 +1,301 @@
+"""Compiled graphs — the aDAG (accelerated DAG) analogue.
+
+Reference: python/ray/dag/compiled_dag_node.py:809 (CompiledDAG: static
+execution schedule + pre-negotiated channels), dag/dag_node.py (bind API),
+experimental/channel/ (typed channels).
+
+TPU-native redesign: compiling a DAG installs a resident node loop on each
+participating actor's worker. Per-edge bounded mailboxes (dag/channels.py)
+are homed on the consumer; a node awaits its input channels, runs the
+actor method, and pushes results straight to the consumers' workers —
+after compile, no driver round-trip, no raylet lease, no GCS touch, and no
+shm-store traffic is on the execute path. With tensor_transport="device",
+edge payloads stay in producer device memory and move point-to-point
+(experimental/device_objects.py). Successive execute() calls pipeline
+through channel depth, the same way the reference overlaps steps.
+
+Usage::
+
+    with InputNode() as inp:
+        x = a.fwd.bind(inp)
+        y = b.loss.bind(x)
+    dag = y.experimental_compile()
+    out = dag.execute(batch).get()
+    dag.teardown()
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .._private import serialization
+from .._private.channels import ChannelClosed
+from .._private.core_worker import RayTaskError, global_worker
+
+__all__ = ["InputNode", "MultiOutputNode", "DAGNode", "CompiledDAG"]
+
+
+class DAGNode:
+    """Base: a node in the static graph."""
+
+    def __init__(self):
+        self._bound_args: Tuple[Any, ...] = ()
+
+    def experimental_compile(self, buffer_depth: int = 2) -> "CompiledDAG":
+        return CompiledDAG(self, buffer_depth=buffer_depth)
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder (reference: dag/input_node.py)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    """actor.method.bind(...) (reference: dag/class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str, args: tuple,
+                 tensor_transport: Optional[str] = None):
+        super().__init__()
+        self.actor = actor_handle
+        self.method_name = method_name
+        self._bound_args = args
+        self.tensor_transport = tensor_transport
+
+    def experimental_compile(self, buffer_depth: int = 2) -> "CompiledDAG":
+        return CompiledDAG(self, buffer_depth=buffer_depth)
+
+
+class MultiOutputNode(DAGNode):
+    """Gather several leaves into one output list."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__()
+        self.outputs = list(outputs)
+
+
+_UNSET = object()
+
+
+class DAGRef:
+    """Handle for one execute(); results pop FIFO per output channel.
+    get() is idempotent — the value (or error) is cached on first fetch,
+    matching ray.get semantics on ObjectRefs."""
+
+    def __init__(self, dag: "CompiledDAG", index: int):
+        self._dag = dag
+        self._index = index
+        self._value = _UNSET
+
+    def get(self, timeout: Optional[float] = 30.0):
+        if self._value is _UNSET:
+            self._value = self._dag._get_result(self._index, timeout)
+        if isinstance(self._value, Exception):
+            raise self._value
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, output: DAGNode, buffer_depth: int = 2):
+        self._worker = global_worker()
+        self.dag_id = f"dag-{uuid.uuid4().hex[:12]}"
+        self._depth = buffer_depth
+        self._exec_count = 0
+        self._next_result = 0
+        self._results: Dict[int, Any] = {}
+        self._staged: List[Optional[tuple]] = []
+        self._lock = threading.Lock()
+        self._torn_down = False
+
+        # ---- flatten graph ------------------------------------------
+        if isinstance(output, MultiOutputNode):
+            leaves = output.outputs
+        else:
+            leaves = [output]
+        self._num_outputs = len(leaves)
+        nodes: List[ClassMethodNode] = []
+        indices: Dict[int, int] = {}  # id(node) -> index
+
+        def visit(n: DAGNode) -> int:
+            if isinstance(n, InputNode):
+                return -1
+            if not isinstance(n, ClassMethodNode):
+                raise TypeError(f"cannot compile node {n!r}")
+            if id(n) in indices:
+                return indices[id(n)]
+            for a in n._bound_args:
+                if isinstance(a, DAGNode):
+                    visit(a)
+            idx = len(nodes)
+            indices[id(n)] = idx
+            nodes.append(n)
+            return idx
+
+        for leaf in leaves:
+            if not isinstance(leaf, ClassMethodNode):
+                raise TypeError("DAG outputs must be actor method nodes")
+            visit(leaf)
+        self._nodes = nodes
+
+        # ---- resolve actor worker addresses -------------------------
+        import time as _time
+
+        gcs = self._worker.gcs
+        addr_of: Dict[int, tuple] = {}
+        for i, n in enumerate(nodes):
+            # actors start asynchronously — wait for the worker address
+            deadline = _time.monotonic() + 60.0
+            while True:
+                info = gcs.get_actor_info(actor_id=n.actor.actor_id)
+                if info and info.get("address"):
+                    addr_of[i] = tuple(info["address"])
+                    break
+                if info and info.get("state") == "DEAD":
+                    raise RuntimeError(
+                        f"actor for DAG node {n.method_name} is dead"
+                    )
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"actor for DAG node {n.method_name} did not "
+                        f"become alive within 60s"
+                    )
+                _time.sleep(0.05)
+        self._addr_of = addr_of
+
+        # ---- channel wiring -----------------------------------------
+        # one channel per (producer=-1 input | node) → (consumer, arg_pos)
+        self._input_targets: List[Tuple[tuple, str]] = []
+        installs: Dict[int, dict] = {}
+        for i, n in enumerate(nodes):
+            arg_specs = []
+            for pos, a in enumerate(n._bound_args):
+                if isinstance(a, InputNode):
+                    cid = f"{self.dag_id}:in->{i}:{pos}"
+                    self._input_targets.append((addr_of[i], cid))
+                    arg_specs.append(("chan", cid))
+                elif isinstance(a, ClassMethodNode):
+                    src = indices[id(a)]
+                    cid = f"{self.dag_id}:{src}->{i}:{pos}"
+                    installs[src]["outs"].append(
+                        (list(addr_of[i]), cid))
+                    arg_specs.append(("chan", cid))
+                else:
+                    arg_specs.append(("lit", serialization.dumps(a)))
+            installs[i] = {
+                "dag_id": self.dag_id,
+                "node_id": i,
+                "method": n.method_name,
+                "args": arg_specs,
+                "outs": [],
+                "depth": buffer_depth,
+                "tensor_transport": n.tensor_transport,
+            }
+        # leaf outputs → driver-homed channels
+        driver_addr = list(self._worker.address)
+        self._out_channels: List[str] = []
+        self._staged = [None] * self._num_outputs
+        for k, leaf in enumerate(leaves):
+            i = indices[id(leaf)]
+            cid = f"{self.dag_id}:{i}->driver:{k}"
+            installs[i]["outs"].append((driver_addr, cid))
+            self._out_channels.append(cid)
+            self._worker.channels.ensure(cid, buffer_depth)
+
+        # ---- install node loops on the actors' workers --------------
+        from .._private.rpc import EventLoopThread
+
+        loop = EventLoopThread.get()
+        for i, spec in installs.items():
+            cli = self._worker._pool.get(*addr_of[i])
+            loop.run(cli.call("dag_install", spec=spec), 30.0)
+
+    # ------------------------------------------------------------------
+    def execute(self, *args) -> DAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        if len(args) != 1:
+            raise TypeError("CompiledDAG.execute takes exactly one input")
+        payload = serialization.dumps(args[0])
+        from .._private.rpc import EventLoopThread
+
+        loop = EventLoopThread.get()
+
+        async def push_all():
+            for addr, cid in self._input_targets:
+                await self._worker.channels.push_remote(
+                    addr, cid, ("v", payload))
+
+        loop.run(push_all(), 60.0)
+        idx = self._exec_count
+        self._exec_count += 1
+        return DAGRef(self, idx)
+
+    def _get_result(self, index: int, timeout: Optional[float]):
+        from .._private.rpc import EventLoopThread
+
+        loop = EventLoopThread.get()
+        with self._lock:
+            while self._next_result <= index:
+                # fill only the channels not yet read for this execution:
+                # a timeout mid-way must not misalign channels across
+                # executions, so partial reads persist in _staged
+                for k, cid in enumerate(self._out_channels):
+                    if self._staged[k] is not None:
+                        continue
+
+                    async def read_one(c=cid):
+                        return await asyncio.wait_for(
+                            self._worker.channels.read(c), timeout)
+
+                    self._staged[k] = loop.run(
+                        read_one(),
+                        None if timeout is None else timeout + 5.0,
+                    )
+                outs, self._staged = (
+                    self._staged, [None] * self._num_outputs
+                )
+                vals = []
+                err = None
+                for kind, payload in outs:
+                    if kind == "closed":
+                        raise ChannelClosed(self.dag_id)
+                    if kind == "err":
+                        err = err or serialization.loads(payload)
+                        vals.append(None)
+                    else:
+                        vals.append(self._worker.decode_channel_item(
+                            kind, payload))
+                result = err if err is not None else (
+                    vals[0] if self._num_outputs == 1 else vals
+                )
+                self._results[self._next_result] = result
+                self._next_result += 1
+            return self._results.pop(index)
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        from .._private.rpc import EventLoopThread
+
+        loop = EventLoopThread.get()
+        for i in range(len(self._nodes)):
+            try:
+                cli = self._worker._pool.get(*self._addr_of[i])
+                loop.run(cli.call("dag_teardown", dag_id=self.dag_id), 10.0)
+            except Exception:
+                pass
+        self._worker.channels.close_all(self.dag_id)
+
+    def __del__(self):
+        try:
+            if not self._torn_down:
+                self.teardown()
+        except Exception:
+            pass

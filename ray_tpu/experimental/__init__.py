@@ -1,0 +1,8 @@
+"""ray_tpu.experimental — device-resident objects (RDT analogue).
+
+Reference: python/ray/experimental/gpu_object_manager/.
+"""
+from .device_objects import (  # noqa: F401
+    DeviceObjectMeta,
+    DeviceObjectStore,
+)

@@ -1,0 +1,166 @@
+"""Device-resident objects — the RDT / GPU-object-store analogue.
+
+Reference: python/ray/experimental/gpu_object_manager/gpu_object_manager.py:50
+(tensor_transport on @ray.method keeps tensors on-device; plasma carries
+only metadata) and experimental/channel/torch_tensor_accelerator_channel.py.
+
+TPU-native redesign: a task/actor-method declared with
+``tensor_transport="device"`` keeps its returned jax.Array pytree in the
+producing worker's device memory (HBM on TPU). The ordinary object path
+carries only a small ``DeviceObjectMeta`` marker, so ownership, refcounts,
+borrowing, and GC all ride the existing owner protocol. Consumers resolve
+the marker on use:
+
+- same process → zero-copy handoff out of the device store;
+- cross process → direct worker-to-worker RPC (``fetch_device_object``),
+  device_get → socket → device_put, bypassing the shm object store and
+  raylet entirely (the DCN plane). On-mesh ICI movement stays where it
+  belongs: inside jitted programs via collectives (SURVEY §5.8 plane 4).
+
+The owner frees the producer-side pin when the object's refcount drops —
+see CoreWorker._free_device_payload.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class DeviceObjectMeta:
+    """Marker value stored in the normal object path."""
+
+    __slots__ = ("oid", "producer_address", "producer_node", "nbytes",
+                 "summary")
+
+    def __init__(self, oid: bytes, producer_address: Tuple[str, int],
+                 producer_node: str, nbytes: int, summary: str):
+        self.oid = oid
+        self.producer_address = tuple(producer_address)
+        self.producer_node = producer_node
+        self.nbytes = nbytes
+        self.summary = summary
+
+    def __reduce__(self):
+        return (DeviceObjectMeta, (self.oid, self.producer_address,
+                                   self.producer_node, self.nbytes,
+                                   self.summary))
+
+    def __repr__(self):
+        return (f"DeviceObjectMeta({self.summary}, {self.nbytes}B @ "
+                f"{self.producer_address})")
+
+
+def _leaf_nbytes(x) -> int:
+    nb = getattr(x, "nbytes", None)
+    return int(nb) if nb is not None else 0
+
+
+def tree_nbytes(value: Any) -> int:
+    import jax
+
+    return sum(_leaf_nbytes(leaf) for leaf in jax.tree_util.tree_leaves(value))
+
+
+def tree_summary(value: Any) -> str:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(value)
+    if not leaves:
+        return "empty"
+    first = leaves[0]
+    shape = getattr(first, "shape", ())
+    dtype = getattr(first, "dtype", "?")
+    return f"{len(leaves)} leaves, leaf0 {dtype}{list(shape)}"
+
+
+def to_wire(value: Any) -> bytes:
+    """Device pytree → host bytes (zero-copy numpy buffers via pickle5)."""
+    import jax
+
+    from .._private import serialization
+
+    host = jax.tree_util.tree_map(
+        lambda x: __import__("numpy").asarray(x), value
+    )
+    return serialization.dumps(host)
+
+
+def device_put_tree(host: Any) -> Any:
+    """Host pytree → this process's default device (copy; the source may
+    be a view over a transient mmap)."""
+    import jax
+
+    try:
+        return jax.tree_util.tree_map(jax.device_put, host)
+    except Exception:
+        return host
+
+
+def from_wire(payload: bytes, device_put: bool = True) -> Any:
+    """Host bytes → device pytree on this process's default device."""
+    from .._private import serialization
+
+    host = serialization.loads(payload)
+    return device_put_tree(host) if device_put else host
+
+
+class DeviceObjectStore:
+    """Per-worker table of device-resident pytrees.
+
+    ``primary``: objects produced here, pinned until the owner frees them.
+    ``cache``: LRU of fetched remote objects (bounded by bytes).
+    """
+
+    def __init__(self, cache_bytes: int = 1 << 30):
+        self._primary: Dict[bytes, Any] = {}
+        self._cache: "collections.OrderedDict[bytes, Any]" = (
+            collections.OrderedDict()
+        )
+        self._cache_nbytes = 0
+        self._cache_cap = cache_bytes
+        self._lock = threading.Lock()
+
+    # --- producer side ------------------------------------------------
+    def put_primary(self, oid: bytes, value: Any):
+        with self._lock:
+            self._primary[oid] = value
+
+    def get_primary(self, oid: bytes) -> Optional[Any]:
+        with self._lock:
+            return self._primary.get(oid)
+
+    def free_primary(self, oid: bytes):
+        with self._lock:
+            self._primary.pop(oid, None)
+            # a consumer-side cached copy of a freed object is still valid
+            # (immutable), keep it until LRU evicts
+
+    # --- consumer side ------------------------------------------------
+    def cache_get(self, oid: bytes) -> Optional[Any]:
+        with self._lock:
+            val = self._cache.get(oid)
+            if val is not None:
+                self._cache.move_to_end(oid)
+            return val
+
+    def cache_put(self, oid: bytes, value: Any, nbytes: int):
+        with self._lock:
+            if oid in self._cache:
+                return
+            self._cache[oid] = value
+            self._cache_nbytes += nbytes
+            while self._cache_nbytes > self._cache_cap and len(self._cache) > 1:
+                _, evicted = self._cache.popitem(last=False)
+                self._cache_nbytes -= max(0, tree_nbytes(evicted))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "primary_count": len(self._primary),
+                "primary_bytes": sum(
+                    tree_nbytes(v) for v in self._primary.values()
+                ),
+                "cache_count": len(self._cache),
+                "cache_bytes": self._cache_nbytes,
+            }

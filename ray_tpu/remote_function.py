@@ -111,6 +111,7 @@ class RemoteFunction:
             name=o.get("name", self.__name__),
             serialized_func=self._pickled,
             func_refs=self._pickled_refs,
+            tensor_transport=o.get("tensor_transport"),
         )
         if num_returns == 1:
             return refs[0]
