@@ -19,6 +19,7 @@ Payload kinds:
 from __future__ import annotations
 
 import asyncio
+import collections
 from typing import Any, Dict, Optional, Tuple
 
 
@@ -29,32 +30,39 @@ class ChannelClosed(Exception):
 class ChannelManager:
     """Per-worker registry of consumer-side mailboxes."""
 
+    # how many torn-down DAG prefixes to remember as tombstones: late
+    # pushes for a dead DAG must fail, but the memory is bounded
+    _MAX_TOMBSTONES = 256
+
     def __init__(self, worker, default_depth: int = 2):
         self._worker = worker
         self._queues: Dict[str, asyncio.Queue] = {}
-        self._closed: set = set()
+        self._closed_prefixes: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict()
+        )
         self._default_depth = default_depth
+
+    def _is_closed(self, channel_id: str) -> bool:
+        return any(channel_id.startswith(p) for p in self._closed_prefixes)
 
     def ensure(self, channel_id: str, depth: Optional[int] = None):
         if channel_id not in self._queues:
             self._queues[channel_id] = asyncio.Queue(
                 maxsize=depth or self._default_depth
             )
-            self._closed.discard(channel_id)
         return self._queues[channel_id]
 
     async def push_local(self, channel_id: str, item: Tuple[str, Any]):
-        if channel_id in self._closed:
+        if self._is_closed(channel_id):
             raise ChannelClosed(channel_id)
         await self.ensure(channel_id).put(item)
 
     async def read(self, channel_id: str) -> Tuple[str, Any]:
-        if channel_id in self._closed:
+        if self._is_closed(channel_id):
             raise ChannelClosed(channel_id)
         return await self.ensure(channel_id).get()
 
     def close(self, channel_id: str):
-        self._closed.add(channel_id)
         q = self._queues.pop(channel_id, None)
         if q is not None:
             # wake blocked readers with a poison pill
@@ -64,6 +72,10 @@ class ChannelManager:
                 pass
 
     def close_all(self, prefix: str = ""):
+        if prefix:
+            self._closed_prefixes[prefix] = None
+            while len(self._closed_prefixes) > self._MAX_TOMBSTONES:
+                self._closed_prefixes.popitem(last=False)
         for cid in [c for c in self._queues if c.startswith(prefix)]:
             self.close(cid)
 

@@ -1686,6 +1686,14 @@ class CoreWorker:
                 )
             host = serialization.loads_from(buf)
             value = devobj.device_put_tree(host)
+            del host
+            # device_put copied out of the mapped pages — drop our read
+            # ref so the producer's later delete can actually reclaim
+            # the staged arena space
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
             if dag_edge:
                 # ack AFTER the staged buffer is fully consumed — the
                 # producer must not free it while we read (the socket

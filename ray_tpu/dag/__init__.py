@@ -231,7 +231,10 @@ class CompiledDAG:
                 await self._worker.channels.push_remote(
                     addr, cid, ("v", payload))
 
-        loop.run(push_all(), 60.0)
+        # blocks under backpressure (channel depth exhausted) — a timeout
+        # here would abandon a half-pushed input and desync every later
+        # execution's results, so fill-or-wait is the only safe policy
+        loop.run(push_all(), None)
         idx = self._exec_count
         self._exec_count += 1
         return DAGRef(self, idx)

@@ -91,10 +91,15 @@ def device_put_tree(host: Any) -> Any:
     be a view over a transient mmap)."""
     import jax
 
-    try:
-        return jax.tree_util.tree_map(jax.device_put, host)
-    except Exception:
-        return host
+    def put(leaf):
+        try:
+            return jax.device_put(leaf)
+        except (TypeError, ValueError):
+            # non-array leaf (str/bytes/None riding in the pytree) —
+            # pass through. Real device errors (OOM etc.) propagate.
+            return leaf
+
+    return jax.tree_util.tree_map(put, host)
 
 
 def from_wire(payload: bytes, device_put: bool = True) -> Any:

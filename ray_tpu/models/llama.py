@@ -43,6 +43,15 @@ class LlamaConfig:
     tie_embeddings: bool = False
     attn: str = "flash"  # flash | ring | ulysses
     remat: bool = True
+    # MoE (0 = dense). Mixtral-style top-k routing; experts shard over
+    # the "expert" mesh axis (models/moe.py).
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    # pipeline parallelism: microbatches per step when the mesh has a
+    # pipe axis > 1 (0 = pick 2*pipe automatically)
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -112,18 +121,27 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
             * (fan_in ** -0.5)
         ).astype(cfg.dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
     layers = {
         "wq": dense(ks[0], d, L, d, cfg.n_heads * hd),
         "wk": dense(ks[1], d, L, d, cfg.n_kv_heads * hd),
         "wv": dense(ks[2], d, L, d, cfg.n_kv_heads * hd),
         "wo": dense(ks[3], cfg.n_heads * hd, L, cfg.n_heads * hd, d),
-        "w1": dense(ks[4], d, L, d, cfg.ffn_dim),
-        "w3": dense(ks[5], d, L, d, cfg.ffn_dim),
-        "w2": dense(ks[6], cfg.ffn_dim, L, cfg.ffn_dim, d),
         "attn_norm": norm_init(L, d),
         "mlp_norm": norm_init(L, d),
     }
+    if cfg.n_experts > 0:
+        from .moe import init_moe_layer
+
+        layers.update(init_moe_layer(
+            ks[7], L, d, cfg.ffn_dim, cfg.n_experts, cfg.dtype
+        ))
+    else:
+        layers.update({
+            "w1": dense(ks[4], d, L, d, cfg.ffn_dim),
+            "w3": dense(ks[5], d, L, d, cfg.ffn_dim),
+            "w2": dense(ks[6], cfg.ffn_dim, L, cfg.ffn_dim, d),
+        })
     params = {
         "tok_embed": (
             jax.random.normal(k_emb, (cfg.vocab_size, d), dtype=jnp.float32)
@@ -138,19 +156,27 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
 
 
 def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
-    """Same-structure tree of logical axis tuples (leading layer axis is
-    unsharded)."""
+    """Same-structure tree of logical axis tuples (the leading "layers"
+    axis maps to the pipe mesh axis — unsharded unless pipe > 1)."""
     layers = {
-        "wq": (None, "embed", "heads"),
-        "wk": (None, "embed", "kv_heads"),
-        "wv": (None, "embed", "kv_heads"),
-        "wo": (None, "heads", "embed"),
-        "w1": (None, "embed", "mlp"),
-        "w3": (None, "embed", "mlp"),
-        "w2": (None, "mlp", "embed"),
-        "attn_norm": (None, "norm"),
-        "mlp_norm": (None, "norm"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "attn_norm": ("layers", "norm"),
+        "mlp_norm": ("layers", "norm"),
     }
+    if cfg.n_experts > 0:
+        from .moe import moe_logical_axes
+
+        for name, axes in moe_logical_axes().items():
+            layers[name] = ("layers",) + axes[1:]
+    else:
+        layers.update({
+            "w1": ("layers", "embed", "mlp"),
+            "w3": ("layers", "embed", "mlp"),
+            "w2": ("layers", "mlp", "embed"),
+        })
     axes = {
         "tok_embed": ("vocab", "embed"),
         "layers": layers,
@@ -207,6 +233,7 @@ def _attention_dispatch(cfg: LlamaConfig, q, k, v, mesh, positions):
 
 
 def _layer(cfg: LlamaConfig, x, lp, mesh, positions):
+    """One transformer block; returns (x, aux_loss)."""
     B, S, d = x.shape
     hd = cfg.head_dim
     h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
@@ -219,9 +246,17 @@ def _layer(cfg: LlamaConfig, x, lp, mesh, positions):
     attn = attn.astype(x.dtype).reshape(B, S, cfg.n_heads * hd)
     x = x + attn @ lp["wo"]
     h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        from .moe import moe_ffn
+
+        y, aux = moe_ffn(
+            h.reshape(B * S, d), lp["router"], lp["we1"], lp["we3"],
+            lp["we2"], cfg.n_experts_per_tok, cfg.capacity_factor,
+        )
+        return x + y.reshape(B, S, d), aux
     gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype)
     x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -229,8 +264,10 @@ def forward(
     params: Dict[str, Any],
     tokens: jax.Array,  # [B, S] int32
     mesh=None,
-) -> jax.Array:
-    """Returns logits [B, S, vocab] (f32)."""
+    return_aux: bool = False,
+):
+    """Returns logits [B, S, vocab] (f32); with return_aux, also the
+    summed MoE load-balance aux loss."""
     B, S = tokens.shape
     x = params["tok_embed"][tokens]  # [B, S, d]
     positions = jnp.arange(S)
@@ -239,15 +276,40 @@ def forward(
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
 
-    def body(x, lp):
-        return layer_fn(x, lp), None
+    pipe = 1
+    if mesh is not None:
+        pipe = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pipe", 1)
+    if pipe > 1:
+        # GPipe-schedule SPMD over the pipe axis (parallel/pipeline.py).
+        # MoE aux loss is not collected on this path (stage outputs carry
+        # activations only).
+        from ..parallel.pipeline import pipeline_apply
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+        M = cfg.pp_microbatches
+        if not M:
+            # auto-pick: largest divisor of B up to 2*pipe
+            M = max(m for m in range(1, min(B, 2 * pipe) + 1)
+                    if B % m == 0)
+        x, aux = pipeline_apply(
+            mesh, params["layers"], x, layer_fn, M, with_aux=True
+        )
+    else:
+        def body(carry, lp):
+            x, aux = carry
+            x, a = layer_fn(x, lp)
+            return (x, aux + a), None
+
+        (x, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"]
+        )
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = (
         params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
     )
-    return (x.astype(jnp.float32) @ head.astype(jnp.float32))
+    logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -323,8 +385,20 @@ def forward_cached(
         attn = _cached_attention(q, k_cache_l, v_cache_l, positions, scale)
         x = x + attn.reshape(B, T, cfg.n_heads * hd) @ lp["wo"]
         h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lp["w1"]).astype(jnp.float32)).astype(x.dtype)
-        x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+        if cfg.n_experts > 0:
+            from .moe import moe_ffn
+
+            y, _ = moe_ffn(
+                h.reshape(B * T, cfg.dim), lp["router"], lp["we1"],
+                lp["we3"], lp["we2"], cfg.n_experts_per_tok,
+                cfg.capacity_factor,
+            )
+            x = x + y.reshape(B, T, cfg.dim)
+        else:
+            gate = jax.nn.silu(
+                (h @ lp["w1"]).astype(jnp.float32)
+            ).astype(x.dtype)
+            x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
         return x, (k_cache_l, v_cache_l)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -345,7 +419,10 @@ def loss_fn(
     mesh=None,
 ) -> jax.Array:
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(cfg, params, inputs, mesh=mesh)
+    logits, aux = forward(cfg, params, inputs, mesh=mesh, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.router_aux_coef * aux / cfg.n_layers
+    return loss

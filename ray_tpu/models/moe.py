@@ -1,0 +1,126 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+Reference counterpart: none — the reference passes MoE models through to
+vLLM via engine_kwargs and places them with PGs (SURVEY §2.3 EP row).
+This is the TPU-native design: GShard/Switch-style capacity-based top-k
+routing expressed as dense einsums, with the expert axis of both weights
+and dispatched activations sharded over the ``expert`` mesh axis — XLA
+lowers the dispatch/combine einsums to all-to-alls over ICI. Dense
+one-hot dispatch (not a sorted ragged kernel) is the right first
+implementation on TPU: it is MXU-shaped, fully static, and fuses; a
+Pallas sorted-dispatch kernel is a later optimization, not a semantic
+change.
+
+Shapes: tokens T = B*S, experts E, capacity C = ceil(capacity_factor *
+k * T / E). Tokens routed beyond an expert's capacity are dropped (their
+combine weight is zero) — standard Switch behavior.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    return max(1, int(capacity_factor * k * n_tokens / n_experts))
+
+
+def moe_ffn(
+    x: jax.Array,  # [T, d] tokens
+    router: jax.Array,  # [d, E]
+    we1: jax.Array,  # [E, d, f]
+    we3: jax.Array,  # [E, d, f]
+    we2: jax.Array,  # [E, f, d]
+    k: int,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (y [T, d], aux_loss scalar f32).
+
+    aux_loss is the GShard load-balancing loss: E * sum_e(frac_tokens_e *
+    frac_router_prob_e), minimized at uniform routing.
+    """
+    T, d = x.shape
+    E = router.shape[-1]
+    C = expert_capacity(T, E, k, capacity_factor)
+
+    logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # [T, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9
+    )
+
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, k, E]
+
+    # position of each (token, slot) within its expert's capacity buffer:
+    # fill slot-0 choices first, then slot-1, ... (GShard ordering)
+    positions = []
+    filled = jnp.zeros((E,), dtype=jnp.float32)
+    for slot in range(k):
+        oh = onehot[:, slot]  # [T, E]
+        pos_in_e = jnp.cumsum(oh, axis=0) - 1.0 + filled[None, :]
+        filled = filled + oh.sum(axis=0)
+        positions.append((pos_in_e * oh).sum(-1))  # [T]
+    pos = jnp.stack(positions, axis=1)  # [T, k]
+    keep = (pos < C).astype(jnp.float32)  # capacity drop
+    gate_vals = gate_vals * keep
+
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                            dtype=jnp.float32)  # [T, k, C]
+    disp = onehot[:, :, :, None] * pos_oh[:, :, None, :] \
+        * keep[:, :, None, None]  # [T, k, E, C]
+    dispatch = disp.sum(axis=1)  # [T, E, C] (0/1)
+    combine = (gate_vals[:, :, None, None] * disp).sum(axis=1)  # [T, E, C]
+
+    # dispatch: [E, C, d] — the einsum XLA turns into an all-to-all when
+    # E is sharded over the expert mesh axis
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch, x.astype(jnp.float32)
+    ).astype(x.dtype)
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, we1).astype(jnp.float32)
+    ).astype(x.dtype)
+    up = jnp.einsum("ecd,edf->ecf", expert_in, we3)
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, we2)  # [E, C, d]
+    y = jnp.einsum(
+        "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+    ).astype(x.dtype)
+
+    # load-balance aux loss (Switch eq.4): fraction of tokens routed to e
+    # (slot-0 argmax) x mean router prob for e
+    frac_tokens = onehot[:, 0].mean(axis=0)  # [E]
+    frac_probs = probs.mean(axis=0)  # [E]
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return y, aux
+
+
+def init_moe_layer(key, n_layers: int, dim: int, ffn_dim: int,
+                   n_experts: int, dtype) -> Dict[str, Any]:
+    """Stacked MoE params [L, E, ...] for the scanned layer tree."""
+    ks = jax.random.split(key, 4)
+
+    def dense(k, fan_in, *shape):
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32)
+            * (fan_in ** -0.5)
+        ).astype(dtype)
+
+    L, E, d, f = n_layers, n_experts, dim, ffn_dim
+    return {
+        "router": dense(ks[0], d, L, d, E).astype(jnp.float32),
+        "we1": dense(ks[1], d, L, E, d, f),
+        "we3": dense(ks[2], d, L, E, d, f),
+        "we2": dense(ks[3], f, L, E, f, d),
+    }
+
+
+def moe_logical_axes() -> Dict[str, Any]:
+    return {
+        "router": (None, "embed", None),
+        "we1": (None, "experts", "embed", "mlp"),
+        "we3": (None, "experts", "embed", "mlp"),
+        "we2": (None, "experts", "mlp", "embed"),
+    }

@@ -5,12 +5,14 @@ topology to torch/NCCL process groups (train/torch/config.py:115) and vLLM.
 Here the mesh IS the cluster abstraction for the compute plane: a named
 `jax.sharding.Mesh` with axes
 
-    ("data", "fsdp", "expert", "tensor", "seq")
+    ("data", "fsdp", "expert", "pipe", "tensor", "seq")
 
   - data   : pure data parallel (gradient psum over DCN or ICI)
   - fsdp   : ZeRO-style parameter sharding (all-gather params, reduce-scatter
              grads), maps to the reference's RayFSDPStrategy delegation
-  - expert : MoE expert parallelism (ragged all-to-all dispatch)
+  - expert : MoE expert parallelism (all-to-all dispatch, GShard-style)
+  - pipe   : pipeline parallelism (GPipe schedule, parallel/pipeline.py;
+             stages = shards of the stacked layer axis)
   - tensor : Megatron tensor parallel (always innermost over ICI)
   - seq    : sequence/context parallel (ring attention / Ulysses)
 
@@ -27,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-AXES = ("data", "fsdp", "expert", "tensor", "seq")
+AXES = ("data", "fsdp", "expert", "pipe", "tensor", "seq")
 
 
 @dataclass(frozen=True)
@@ -37,6 +39,7 @@ class MeshSpec:
     data: int = 1
     fsdp: int = -1
     expert: int = 1
+    pipe: int = 1
     tensor: int = 1
     seq: int = 1
 
@@ -45,6 +48,7 @@ class MeshSpec:
             "data": self.data,
             "fsdp": self.fsdp,
             "expert": self.expert,
+            "pipe": self.pipe,
             "tensor": self.tensor,
             "seq": self.seq,
         }

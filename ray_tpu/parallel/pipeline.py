@@ -1,0 +1,144 @@
+"""Pipeline parallelism: GPipe-schedule SPMD over a ``pipe`` mesh axis.
+
+Reference counterpart: the reference only *plumbs* pipeline_parallel_size
+through to vLLM (llm/.../vllm_models.py:210-220) and provides compiled
+graphs as a substrate (dag/compiled_dag_node.py:809) — it contains no
+pipeline schedule of its own. This is the TPU-native design the SURVEY
+(§2.3 PP row) calls for: stages are shards of the stacked layer axis, and
+the schedule is a single jitted program.
+
+Mechanics: the stacked layer parameters [L, ...] are sharded over the
+``pipe`` axis, so each stage holds L/P contiguous layers. Activations
+move stage-to-stage with `lax.ppermute` over ICI/DCN inside one
+`lax.scan` over M + P - 1 ticks (GPipe: bubble fraction (P-1)/(M+P-1)).
+Only the pipe axis is manual (`jax.shard_map(axis_names={"pipe"})`);
+data/fsdp/tensor shardings stay with GSPMD, so the same model code runs
+dp x fsdp x pp x tp without edits.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(
+    mesh,
+    layer_params,
+    x: jax.Array,
+    stage_fn: Callable,
+    n_microbatches: int,
+    axis_name: str = "pipe",
+    with_aux: bool = False,
+):
+    """Run stacked layers over ``x`` [B, S, d] with pipeline parallelism.
+
+    ``layer_params``: pytree with leading layer axis [L, ...], sharded
+    over ``axis_name``. ``stage_fn(h, lp) -> h`` applies ONE layer — or,
+    with ``with_aux``, returns ``(h, aux)`` whose scalar aux terms (e.g.
+    the MoE load-balance loss) are summed across layers and microbatches
+    exactly as the plain scan would. Requires B % n_microbatches == 0
+    and L % P == 0 (enforced by the sharding).
+    """
+    if with_aux:
+        raw_stage_fn = stage_fn
+    else:
+        def raw_stage_fn(h, lp):
+            return stage_fn(h, lp), jnp.zeros((), jnp.float32)
+
+    p_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if p_size == 1:
+        def body(carry, lp):
+            h, aux = carry
+            h, a = raw_stage_fn(h, lp)
+            return (h, aux + a), None
+
+        (out, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), layer_params
+        )
+        return (out, aux) if with_aux else out
+
+    B = x.shape[0]
+    M = n_microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    x_mb = x.reshape(M, mb, *x.shape[1:])
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda _: P(axis_name), layer_params
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=(P(), P()),
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )
+    def run(local_layers, x_all):
+        # x_all: [M, mb, S, d] replicated w.r.t. pipe
+        s = jax.lax.axis_index(axis_name)
+        P_ = jax.lax.axis_size(axis_name)
+
+        def stage(h):
+            def body(carry, lp):
+                h, aux = carry
+                h, a = raw_stage_fn(h, lp)
+                return (h, aux + a), None
+
+            (h, aux), _ = jax.lax.scan(
+                body, (h, jnp.zeros((), jnp.float32)), local_layers
+            )
+            return h, aux
+
+        zeros = jnp.zeros_like(x_all[0])
+
+        def tick(carry, t):
+            current, outputs, aux_acc = carry
+            # stage 0 ingests microbatch t (clamped; ticks >= M feed
+            # garbage that never reaches the collected outputs)
+            inject = x_all[jnp.minimum(t, M - 1)]
+            current = jnp.where(s == 0, inject, current)
+            out, aux = stage(current)
+            # stage s holds microbatch (t - s); its aux only counts when
+            # that microbatch index is real
+            valid = (t >= s) & (t - s < M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            # last stage finished microbatch (t - (P-1)) at tick t
+            m_idx = jnp.clip(t - (P_ - 1), 0, M - 1)
+            store = (s == P_ - 1) & (t >= P_ - 1)
+            outputs = outputs.at[m_idx].set(
+                jnp.where(store, out, outputs[m_idx])
+            )
+            nxt = jax.lax.ppermute(
+                out, axis_name,
+                [(i, (i + 1) % P_) for i in range(P_)],
+            )
+            return (nxt, outputs, aux_acc), None
+
+        out_buf = jnp.zeros((M,) + x_all.shape[1:], x_all.dtype)
+        (_, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (zeros, out_buf, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + P_ - 1),
+        )
+        # broadcast collected outputs from the last stage to every stage
+        # (each stage's buffer is zeros except stage P-1's); aux sums
+        # across stages
+        outputs = jax.lax.psum(
+            jnp.where(s == P_ - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name,
+        )
+        aux_total = jax.lax.psum(aux_acc, axis_name)
+        return outputs, aux_total
+
+    y, aux = run(layer_params, x_mb)
+    y = y.reshape(B, *x.shape[1:])
+    # per-microbatch aux terms are means over mb tokens; rescale to the
+    # full-batch mean the unpipelined scan computes
+    aux = aux / M
+    return (y, aux) if with_aux else y

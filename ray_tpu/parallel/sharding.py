@@ -23,12 +23,14 @@ LOGICAL_RULES: Dict[str, Optional[object]] = {
     "act_heads": "tensor",
     "act_kv": None,
     # params
+    "layers": "pipe",         # stacked layer axis -> pipeline stages
     "embed": "fsdp",          # ZeRO: shard the embed dim of every weight
     "mlp": "tensor",          # Megatron column/row split
     "heads": "tensor",
     "kv_heads": "tensor",
     "qkv_dim": None,
     "vocab": "tensor",
+    "experts": "expert",      # MoE expert axis -> expert parallelism
     "expert": "expert",
     "norm": None,
 }

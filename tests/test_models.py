@@ -94,7 +94,8 @@ def test_sharded_train_step_runs_and_matches_structure(cfg):
     init, step = make_train_step(cfg, mesh)
     state = init(0)
     spec = state.params["layers"]["wq"].sharding.spec
-    assert spec == jax.sharding.PartitionSpec(None, "fsdp", "tensor")
+    # leading layer axis maps to "pipe" (size 1 here -> no-op sharding)
+    assert spec == jax.sharding.PartitionSpec("pipe", "fsdp", "tensor")
     tokens = jnp.asarray(
         np.random.default_rng(1).integers(0, cfg.vocab_size, (8, 17)),
         dtype=jnp.int32,

@@ -36,7 +36,8 @@ def reference_attention(q, k, v, causal=True):
 
 def test_mesh_spec_resolution():
     assert MeshSpec(fsdp=-1).resolve(8) == {
-        "data": 1, "fsdp": 8, "expert": 1, "tensor": 1, "seq": 1
+        "data": 1, "fsdp": 8, "expert": 1, "pipe": 1, "tensor": 1,
+        "seq": 1
     }
     assert MeshSpec(data=2, fsdp=-1, tensor=2).resolve(8)["fsdp"] == 2
     with pytest.raises(ValueError):

@@ -1,0 +1,192 @@
+"""Pipeline parallelism + MoE expert parallelism tests (8-device CPU mesh).
+
+VERDICT item 5 'done' bar: dryrun variants for pp=2 and expert=2 meshes
+with finite loss — covered here plus numeric equivalence of the pipeline
+schedule against the plain layer scan.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import LlamaConfig, init_params, make_train_step
+from ray_tpu.models.llama import forward, loss_fn
+from ray_tpu.parallel import MeshSpec, create_mesh
+from ray_tpu.parallel.pipeline import pipeline_apply
+
+
+def _tokens(cfg, B, S, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, cfg.vocab_size, (B, S)),
+        dtype=jnp.int32,
+    )
+
+
+# ---------------------------------------------------------------------------
+# pipeline schedule correctness
+# ---------------------------------------------------------------------------
+def test_pipeline_apply_matches_scan():
+    """The GPipe schedule must be numerically identical to the plain
+    lax.scan over layers."""
+    mesh = create_mesh(MeshSpec(pipe=4, fsdp=2))
+    L, B, S, d = 8, 4, 16, 32
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (L, d, d), dtype=jnp.float32) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, S, d))
+
+    def layer(h, wl):
+        return jnp.tanh(h @ wl)
+
+    def ref(x):
+        def body(h, wl):
+            return layer(h, wl), None
+
+        return jax.lax.scan(body, x, w)[0]
+
+    expected = ref(x)
+    got = jax.jit(
+        lambda w_, x_: pipeline_apply(mesh, w_, x_, layer, 4)
+    )(w, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_forward_matches_single_device():
+    """Full Llama forward under a pipe=2 mesh == unpipelined logits."""
+    cfg = LlamaConfig.tiny(n_layers=4, pp_microbatches=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _tokens(cfg, 4, 16)
+
+    plain = forward(cfg, params, tokens, mesh=None)
+
+    mesh = create_mesh(MeshSpec(pipe=2, fsdp=4))
+    piped = jax.jit(
+        lambda p, t: forward(cfg, p, t, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(piped), np.asarray(plain), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pipeline_train_step_finite_loss():
+    mesh = create_mesh(MeshSpec(pipe=2, fsdp=2, data=2))
+    cfg = LlamaConfig.tiny(n_layers=4, pp_microbatches=2)
+    init, step = make_train_step(cfg, mesh)
+    state = init(0)
+    tokens = _tokens(cfg, 4, 17)
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    state, m2 = step(state, tokens)
+    assert float(m2["loss"]) < float(metrics["loss"]) + 1.0
+
+
+# ---------------------------------------------------------------------------
+# MoE
+# ---------------------------------------------------------------------------
+def test_moe_ffn_routing_and_shapes():
+    from ray_tpu.models.moe import moe_ffn
+
+    T, d, f, E, k = 32, 16, 32, 4, 2
+    keys = jax.random.split(jax.random.PRNGKey(0), 5)
+    x = jax.random.normal(keys[0], (T, d))
+    router = jax.random.normal(keys[1], (d, E)) * 0.5
+    we1 = jax.random.normal(keys[2], (E, d, f)) * 0.1
+    we3 = jax.random.normal(keys[3], (E, d, f)) * 0.1
+    we2 = jax.random.normal(keys[4], (E, f, d)) * 0.1
+
+    y, aux = moe_ffn(x, router, we1, we3, we2, k, capacity_factor=4.0)
+    assert y.shape == (T, d)
+    assert np.isfinite(np.asarray(y)).all()
+    assert float(aux) > 0
+
+    # with ample capacity, each token's output == weighted sum of its
+    # top-k experts' dense ffn outputs
+    probs = jax.nn.softmax(x @ router, axis=-1)
+    gate_vals, idx = jax.lax.top_k(probs, k)
+    gate_vals = gate_vals / gate_vals.sum(-1, keepdims=True)
+
+    def dense_expert(e, xi):
+        g = jax.nn.silu(xi @ we1[e]) * (xi @ we3[e])
+        return g @ we2[e]
+
+    for t in range(0, T, 7):
+        want = sum(
+            float(gate_vals[t, j]) * dense_expert(int(idx[t, j]), x[t])
+            for j in range(k)
+        )
+        np.testing.assert_allclose(
+            np.asarray(y[t]), np.asarray(want), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_moe_capacity_drops_tokens():
+    from ray_tpu.models.moe import moe_ffn
+
+    T, d, f, E = 16, 8, 16, 2
+    keys = jax.random.split(jax.random.PRNGKey(1), 5)
+    x = jax.random.normal(keys[0], (T, d))
+    # zero router → uniform probs → top_k tie-breaks every token to
+    # expert 0 → capacity overflow drops tokens
+    router = jnp.zeros((d, E))
+    we1 = jax.random.normal(keys[2], (E, d, f)) * 0.1
+    we3 = jax.random.normal(keys[3], (E, d, f)) * 0.1
+    we2 = jax.random.normal(keys[4], (E, f, d)) * 0.1
+    y, aux = moe_ffn(x, router, we1, we3, we2, 1, capacity_factor=0.5)
+    # capacity = 0.5 * 1 * 16 / 2 = 4 → only 4 tokens routed, rest zero
+    nonzero = np.abs(np.asarray(y)).sum(-1) > 1e-9
+    assert nonzero.sum() == 4
+    assert float(aux) > 0.9  # unbalanced routing penalized (max = E = 2)
+
+
+def test_moe_train_step_expert_mesh_finite_loss():
+    """expert=2 mesh: expert weights sharded over the expert axis, one
+    full train step, finite decreasing loss."""
+    mesh = create_mesh(MeshSpec(expert=2, fsdp=2, data=2))
+    cfg = LlamaConfig.tiny(n_layers=2, n_experts=4, n_experts_per_tok=2)
+    init, step = make_train_step(cfg, mesh)
+    state = init(0)
+
+    # expert weights actually sharded over the expert axis
+    we1 = state.params["layers"]["we1"]
+    spec = we1.sharding.spec
+    assert "expert" in str(spec), f"we1 not expert-sharded: {spec}"
+
+    tokens = _tokens(cfg, 4, 17)
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    losses = [float(metrics["loss"])]
+    for _ in range(3):
+        state, metrics = step(state, tokens)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_with_pipeline_collects_aux():
+    """MoE + PP: the pipeline schedule must carry the router aux loss
+    (equal to the unpipelined value up to microbatch statistics)."""
+    cfg = LlamaConfig.tiny(n_layers=4, n_experts=4, n_experts_per_tok=2,
+                           pp_microbatches=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _tokens(cfg, 4, 16)
+
+    _, aux_plain = forward(cfg, params, tokens, mesh=None,
+                           return_aux=True)
+    mesh = create_mesh(MeshSpec(pipe=2, fsdp=4))
+    logits, aux_pp = jax.jit(
+        lambda p, t: forward(cfg, p, t, mesh=mesh, return_aux=True)
+    )(params, tokens)
+    assert float(aux_pp) > 0
+    # microbatch fractions differ slightly from full-batch fractions
+    np.testing.assert_allclose(float(aux_pp), float(aux_plain),
+                               rtol=0.25)
+
+
+def test_moe_grads_reach_experts():
+    cfg = LlamaConfig.tiny(n_layers=2, n_experts=4, n_experts_per_tok=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = _tokens(cfg, 2, 17)
+    grads = jax.grad(lambda p: loss_fn(cfg, p, tokens))(params)
+    g = np.asarray(grads["layers"]["we1"])
+    assert np.abs(g).sum() > 0
+    gr = np.asarray(grads["layers"]["router"])
+    assert np.abs(gr).sum() > 0
