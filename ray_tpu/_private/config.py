@@ -85,6 +85,8 @@ class Config:
     log_to_driver: bool = True
     event_stats: bool = False
     metrics_report_interval_s: float = 5.0
+    # Prometheus scrape endpoint per node (0 = pick free port, -1 = off).
+    metrics_export_port: int = 0
     task_events_max_buffer_size: int = 10000
 
     # --- misc ---

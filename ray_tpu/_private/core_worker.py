@@ -400,6 +400,7 @@ class CoreWorker:
         _global_worker = self
         loop.spawn(self._flush_task_events_loop())
         loop.spawn(self._actor_event_loop())
+        loop.spawn(self._metrics_flush_loop())
 
     def shutdown(self):
         self._exit.set()
@@ -473,6 +474,32 @@ class CoreWorker:
         return "pong"
 
     # ==================================================================
+    # metrics (reference: src/ray/stats/metric_defs.cc — core counters
+    # exported via the node metrics agent; here the raylet is the agent)
+    # ==================================================================
+    def _count(self, name: str, desc: str = "", n: float = 1.0):
+        from .metrics import get_registry
+
+        get_registry().counter(name, desc).inc(n)
+
+    async def _metrics_flush_loop(self):
+        from .metrics import get_registry
+
+        if self._cfg.metrics_export_port < 0:
+            return  # export disabled: don't ship unscrapeable snapshots
+        interval = max(0.5, self._cfg.metrics_report_interval_s)
+        while not self._exit.is_set():
+            await asyncio.sleep(interval)
+            try:
+                await self.raylet.call(
+                    "report_metrics",
+                    worker_id=self.worker_id,
+                    snapshot=get_registry().snapshot(),
+                )
+            except Exception:
+                pass
+
+    # ==================================================================
     # put / get / wait
     # ==================================================================
     def _next_put_id(self) -> ObjectID:
@@ -480,6 +507,7 @@ class CoreWorker:
         return ObjectID.for_task_return(self._put_task_id, self._put_index)
 
     def put_object(self, value: Any, _owner_inline_hint: bool = True) -> ObjectRef:
+        self._count("ray_tpu_objects_put_total", "ray.put calls")
         oid = self._next_put_id()
         meta, buffers = serialization.serialize(value)
         size = serialization.serialized_size(meta, buffers)
@@ -1052,6 +1080,8 @@ class CoreWorker:
                 retained=[r.id for r in arg_refs],
             )
         self._record_task_event(spec, "PENDING")
+        self._count("ray_tpu_tasks_submitted_total",
+                    "tasks submitted by this worker")
         pool = self._lease_pool(demand, strategy, strategy_params)
         pool.enqueue(spec)
         return [
@@ -1161,9 +1191,13 @@ class CoreWorker:
                     self._free_object(oid, rec)
         self._notify_ready()
         self._record_task_event(spec, "FINISHED")
+        self._count("ray_tpu_tasks_finished_total",
+                    "tasks finished successfully")
 
     def _on_task_failed(self, spec: dict, error: Exception) -> bool:
         """Returns True if the task will be retried."""
+        self._count("ray_tpu_tasks_failed_total",
+                    "task attempts that failed")
         task_id = spec["task_id"]
         with self._records_lock:
             task = self._tasks.get(task_id)
@@ -1265,6 +1299,7 @@ class CoreWorker:
             "job_id": self.job_id.hex(),
             "name": name,
             "namespace": namespace,
+            "class_name": getattr(cls, "__name__", ""),
             "demand": dict(demand or {"CPU": 1.0}),
             "max_restarts": max_restarts,
             "max_task_retries": max_task_retries,

@@ -1,0 +1,195 @@
+"""Metrics registry + Prometheus text rendering.
+
+Reference: src/ray/stats/metric.h:110 (Metric + macro registry,
+metric_defs.cc) and python/ray/_private/metrics_agent.py:651 (per-node
+agent serving Prometheus). Redesign: one in-process registry per worker/
+raylet; workers flush snapshots to their raylet over the existing RPC
+plane; the raylet renders the node-wide scrape (its own registry + the
+latest snapshot from each live worker).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0,
+)
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple:
+    return tuple(sorted(labels.items()))
+
+
+class _Metric:
+    def __init__(self, name: str, description: str, kind: str):
+        self.name = name
+        self.description = description
+        self.kind = kind  # counter | gauge | histogram
+        self._series: Dict[Tuple, object] = {}
+        self._lock = threading.Lock()
+
+
+class CounterImpl(_Metric):
+    def __init__(self, name, description=""):
+        super().__init__(name, description, "counter")
+
+    def inc(self, value: float = 1.0, labels: Optional[Dict] = None):
+        key = _label_key(labels or {})
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class GaugeImpl(_Metric):
+    def __init__(self, name, description=""):
+        super().__init__(name, description, "gauge")
+
+    def set(self, value: float, labels: Optional[Dict] = None):
+        with self._lock:
+            self._series[_label_key(labels or {})] = float(value)
+
+
+class HistogramImpl(_Metric):
+    def __init__(self, name, description="", boundaries: Sequence[float]
+                 = _DEFAULT_BUCKETS):
+        super().__init__(name, description, "histogram")
+        self.boundaries = tuple(boundaries)
+
+    def observe(self, value: float, labels: Optional[Dict] = None):
+        key = _label_key(labels or {})
+        with self._lock:
+            ent = self._series.get(key)
+            if ent is None:
+                ent = {"count": 0, "sum": 0.0,
+                       "buckets": [0] * len(self.boundaries)}
+                self._series[key] = ent
+            ent["count"] += 1
+            ent["sum"] += value
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    ent["buckets"][i] += 1
+
+
+class MetricsRegistry:
+    """Process-local registry; snapshot() produces a wire-serializable
+    view, render() produces Prometheus exposition text."""
+
+    def __init__(self, default_labels: Optional[Dict[str, str]] = None):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self.default_labels = dict(default_labels or {})
+
+    def counter(self, name, description="") -> CounterImpl:
+        return self._get(name, lambda: CounterImpl(name, description))
+
+    def gauge(self, name, description="") -> GaugeImpl:
+        return self._get(name, lambda: GaugeImpl(name, description))
+
+    def histogram(self, name, description="",
+                  boundaries=_DEFAULT_BUCKETS) -> HistogramImpl:
+        return self._get(
+            name, lambda: HistogramImpl(name, description, boundaries)
+        )
+
+    def _get(self, name, make):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = make()
+                self._metrics[name] = m
+            return m
+
+    def snapshot(self) -> List[dict]:
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            with m._lock:
+                # deep-copy histogram entries: the live observe() path
+                # mutates 'buckets' in place after we release the lock
+                series = {
+                    k: (
+                        {**v, "buckets": list(v["buckets"])}
+                        if isinstance(v, dict) else v
+                    )
+                    for k, v in m._series.items()
+                }
+            entry = {
+                "name": m.name,
+                "desc": m.description,
+                "kind": m.kind,
+                "series": [
+                    {"labels": list(k), "value": v}
+                    for k, v in series.items()
+                ],
+            }
+            if m.kind == "histogram":
+                entry["boundaries"] = list(m.boundaries)
+            out.append(entry)
+        return out
+
+
+def render_prometheus(snapshots: List[Tuple[Dict[str, str], List[dict]]]
+                      ) -> str:
+    """Render (extra_labels, snapshot) pairs as Prometheus text."""
+    by_name: Dict[str, List] = {}
+    meta: Dict[str, Tuple[str, str]] = {}
+    for extra, snap in snapshots:
+        for m in snap:
+            meta[m["name"]] = (m["kind"], m.get("desc", ""))
+            for s in m["series"]:
+                labels = dict(s["labels"])
+                labels.update(extra)
+                by_name.setdefault(m["name"], []).append(
+                    (labels, s["value"], m.get("boundaries"))
+                )
+    def esc(v) -> str:
+        # Prometheus exposition label escaping: backslash, quote, newline
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    lines = []
+    for name, series in sorted(by_name.items()):
+        kind, desc = meta[name]
+        if desc:
+            lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind}")
+        for labels, value, boundaries in series:
+            lab = ",".join(
+                f'{k}="{esc(v)}"' for k, v in sorted(labels.items())
+            )
+            if kind == "histogram":
+                # observe() stores cumulative bucket counts already
+                for b, c in zip(boundaries, value["buckets"]):
+                    blab = lab + ("," if lab else "") + f'le="{b}"'
+                    lines.append(f"{name}_bucket{{{blab}}} {c}")
+                blab = lab + ("," if lab else "") + 'le="+Inf"'
+                lines.append(f"{name}_bucket{{{blab}}} {value['count']}")
+                lines.append(
+                    f"{name}_sum{{{lab}}} {value['sum']}" if lab
+                    else f"{name}_sum {value['sum']}"
+                )
+                lines.append(
+                    f"{name}_count{{{lab}}} {value['count']}" if lab
+                    else f"{name}_count {value['count']}"
+                )
+            else:
+                if lab:
+                    lines.append(f"{name}{{{lab}}} {value}")
+                else:
+                    lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"
+
+
+# process-global registry (workers + drivers)
+_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    global _registry
+    with _registry_lock:
+        if _registry is None:
+            _registry = MetricsRegistry()
+        return _registry

@@ -169,6 +169,13 @@ class Raylet:
         self._lease_waiters: collections.deque = collections.deque()
         self._lease_wakeup = asyncio.Event()
 
+        # per-worker metric snapshots (reference: metrics_agent.py —
+        # every process exports to the node agent; here the raylet IS
+        # the node agent)
+        self._worker_metrics: Dict[str, list] = {}
+        self._metrics_site = None
+        self.metrics_address: Optional[Tuple[str, int]] = None
+
         # cluster view (from heartbeat replies)
         self._view: Dict[str, NodeView] = {}
         self._sched = ClusterResourceScheduler(
@@ -212,6 +219,7 @@ class Raylet:
     async def start(self):
         await self._server.start()
         self.address = self._server.address
+        await self._start_metrics_endpoint()
         await self.gcs.aio.call(
             "register_node",
             info={
@@ -224,6 +232,10 @@ class Raylet:
                 "is_head": self.is_head,
                 "session_dir": self.session_dir,
                 "pid": os.getpid(),
+                "metrics_address": (
+                    list(self.metrics_address)
+                    if self.metrics_address else None
+                ),
             },
         )
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -236,6 +248,11 @@ class Raylet:
     async def stop(self):
         for t in self._bg:
             t.cancel()
+        if self._metrics_site is not None:
+            try:
+                await self._metrics_site.cleanup()
+            except Exception:
+                pass
         for w in self._workers.values():
             try:
                 w.proc.terminate()
@@ -373,6 +390,7 @@ class Raylet:
                 if handle.alive and handle.proc.poll() is not None:
                     handle.alive = False
                     self._workers.pop(wid, None)
+                    self._worker_metrics.pop(wid, None)
                     # free resources of any lease it held
                     for lid, lease in list(self._leases.items()):
                         if lease.worker.worker_id == wid:
@@ -868,6 +886,94 @@ class Raylet:
         return True
 
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # metrics agent (reference: _private/metrics_agent.py:651 — here the
+    # raylet doubles as the per-node agent)
+    # ------------------------------------------------------------------
+    async def _start_metrics_endpoint(self):
+        cfg_port = self._cfg.metrics_export_port
+        if cfg_port < 0:
+            return
+        try:
+            from aiohttp import web
+
+            async def handle_metrics(request):
+                return web.Response(
+                    text=self._render_metrics(),
+                    content_type="text/plain",
+                )
+
+            app = web.Application()
+            app.router.add_get("/metrics", handle_metrics)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            # bind the raylet's serving host so the published address is
+            # reachable off-node in multi-node deployments
+            host = self.address[0] if self.address else "127.0.0.1"
+            site = web.TCPSite(runner, host, cfg_port or 0)
+            await site.start()
+            sock = site._server.sockets[0]
+            self.metrics_address = sock.getsockname()[:2]
+            self._metrics_site = runner
+        except Exception as e:
+            # observability must never take the node down (port in use,
+            # missing aiohttp, ...): run without a scrape endpoint
+            print(f"[raylet] metrics endpoint disabled: {e}", flush=True)
+            self.metrics_address = None
+
+    def _render_metrics(self) -> str:
+        from .metrics import MetricsRegistry, render_prometheus
+
+        own = MetricsRegistry()
+        own.gauge(
+            "ray_tpu_node_resource_total", "configured node resources"
+        )
+        own.gauge(
+            "ray_tpu_node_resource_available", "available node resources"
+        )
+        for k, v in self.total.items():
+            own.gauge("ray_tpu_node_resource_total").set(
+                v, {"resource": k})
+        for k, v in self.available.items():
+            own.gauge("ray_tpu_node_resource_available").set(
+                v, {"resource": k})
+        st = self.store.stats()
+        g = own.gauge("ray_tpu_object_store_bytes", "shm arena usage")
+        g.set(st.get("bytes_in_use", 0), {"kind": "used"})
+        g.set(st.get("capacity", 0), {"kind": "capacity"})
+        own.gauge("ray_tpu_object_store_objects",
+                  "sealed objects in the arena").set(
+            st.get("num_objects", 0))
+        own.gauge("ray_tpu_workers", "worker processes").set(
+            len(self._workers))
+        own.gauge("ray_tpu_active_leases", "granted leases").set(
+            len(self._leases))
+        # prune stale reporters (exited drivers are not in self._workers,
+        # so age is the only universal liveness signal)
+        ttl = max(60.0, 6 * self._cfg.metrics_report_interval_s)
+        now = time.time()
+        for wid, (ts, _) in list(self._worker_metrics.items()):
+            if now - ts > ttl:
+                self._worker_metrics.pop(wid, None)
+        snaps = [({"node_id": self.node_id}, own.snapshot())]
+        for wid, (_, snap) in list(self._worker_metrics.items()):
+            snaps.append(
+                ({"node_id": self.node_id, "worker_id": wid[:12]}, snap)
+            )
+        return render_prometheus(snaps)
+
+    async def report_metrics(self, worker_id: str, snapshot: list):
+        """Workers flush their registry snapshots here periodically."""
+        self._worker_metrics[worker_id] = (time.time(), snapshot)
+        return True
+
+    async def list_store_objects(self, limit: int = 10000):
+        """State API source: objects sealed in this node's arena."""
+        out = []
+        for oid in self.store.list_objects(max_ids=limit):
+            out.append({"object_id": oid.hex(), "node_id": self.node_id})
+        return out
+
     async def node_info(self):
         return {
             "node_id": self.node_id,
@@ -878,6 +984,7 @@ class Raylet:
             "labels": self.labels,
             "num_workers": len(self._workers),
             "num_idle": sum(len(d) for d in self._idle_workers.values()),
+            "workers": list(self._workers.keys()),
             "store": self.store.stats(),
         }
 

@@ -1,4 +1,6 @@
+from . import metrics  # noqa: F401
 from . import scheduling_strategies  # noqa: F401
+from . import state  # noqa: F401
 from .placement_group import (  # noqa: F401
     PlacementGroup,
     placement_group,

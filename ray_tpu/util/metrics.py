@@ -1,0 +1,62 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py (-> includes/metric.pxi -> C++
+stats). Here metrics record into the process-local registry
+(_private/metrics.py); the worker's flush loop ships snapshots to its
+raylet, which serves the node-wide Prometheus scrape on
+http://<node>:<metrics_port>/metrics.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from .._private.metrics import get_registry
+
+
+class Counter:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self._impl = get_registry().counter(name, description)
+        self._tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._tags = dict(tags)
+        return self
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        self._impl.inc(value, {**self._tags, **(tags or {})})
+
+
+class Gauge:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self._impl = get_registry().gauge(name, description)
+        self._tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._tags = dict(tags)
+        return self
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._impl.set(value, {**self._tags, **(tags or {})})
+
+
+class Histogram:
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (),
+                 tag_keys: Sequence[str] = ()):
+        from .._private.metrics import _DEFAULT_BUCKETS
+
+        self._impl = get_registry().histogram(
+            name, description, tuple(boundaries) or _DEFAULT_BUCKETS
+        )
+        self._tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._tags = dict(tags)
+        return self
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None):
+        self._impl.observe(value, {**self._tags, **(tags or {})})

@@ -1,0 +1,171 @@
+"""State observability API: list/summarize cluster entities.
+
+Reference: python/ray/util/state/api.py (`ray list actors/tasks/...`)
+backed by StateAPIManager (state_manager.py:94) over GCS + per-node
+sources. Here the sources are the GCS tables directly (actors, PGs,
+jobs, nodes, task events) and per-raylet RPCs (workers, store objects),
+queried through the connected driver's clients.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .._private.core_worker import global_worker
+
+
+def _gcs():
+    return global_worker().gcs
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    out = []
+    for n in _gcs().get_all_nodes():
+        out.append({
+            "node_id": n["node_id"],
+            "state": "ALIVE" if n.get("alive", True) else "DEAD",
+            "is_head": n.get("is_head", False),
+            "address": n.get("address"),
+            "resources_total": n.get("total", n.get("resources", {})),
+            "resources_available": n.get("available", {}),
+            "labels": n.get("labels", {}),
+        })
+    return out
+
+
+def list_actors(filters: Optional[Dict[str, Any]] = None
+                ) -> List[Dict[str, Any]]:
+    out = []
+    for a in _gcs().get_all_actors():
+        rec = {
+            "actor_id": a.get("actor_id"),
+            "state": a.get("state"),
+            "name": a.get("name") or "",
+            "namespace": a.get("namespace", ""),
+            "class_name": a.get("class_name", ""),
+            "node_id": a.get("node_id"),
+            "pid": a.get("pid"),
+            "restarts": a.get("restarts", 0),
+            "detached": a.get("detached", False),
+        }
+        if _match(rec, filters):
+            out.append(rec)
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    out = []
+    for pg in _gcs().get_all_placement_groups():
+        out.append({
+            "placement_group_id": pg.get("pg_id", pg.get("id")),
+            "state": pg.get("state"),
+            "strategy": pg.get("strategy"),
+            "bundles": pg.get("bundles"),
+            "name": pg.get("name", ""),
+        })
+    return out
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return list(_gcs().get_all_jobs())
+
+
+def list_tasks(job_id: Optional[str] = None, limit: int = 1000
+               ) -> List[Dict[str, Any]]:
+    """Latest known status per task from the GCS task-event store
+    (reference: GcsTaskManager gcs_task_manager.h:94)."""
+    events = _gcs().get_task_events(job_id=job_id, limit=10 * limit)
+    latest: Dict[str, dict] = {}
+    order = {"PENDING": 0, "RETRYING": 1, "RUNNING": 2,
+             "FINISHED": 3, "FAILED": 3}
+    for e in events:
+        tid = e.get("task_id")
+        if tid is None:
+            continue
+        cur = latest.get(tid)
+        if cur is None or e.get("ts", 0) >= cur.get("ts", 0):
+            merged = dict(cur or {})
+            merged.update({k: v for k, v in e.items() if v is not None})
+            # never regress a terminal state with a stale event
+            if cur and order.get(cur.get("state"), 0) > order.get(
+                    e.get("state"), 0):
+                merged["state"] = cur["state"]
+            latest[tid] = merged
+    out = [
+        {
+            "task_id": tid,
+            "name": e.get("name", ""),
+            "state": e.get("state"),
+            "job_id": e.get("job_id"),
+            "node_id": e.get("node_id"),
+        }
+        for tid, e in latest.items()
+    ]
+    return out[:limit]
+
+
+def _fanout_raylets(method: str, timeout: float = 5.0, **kwargs
+                    ) -> List[tuple]:
+    """Call one RPC on every alive raylet concurrently; returns
+    [(node, result)] for the nodes that answered — one slow node costs
+    one timeout, not one per node."""
+    import asyncio
+
+    from .._private.rpc import EventLoopThread
+
+    w = global_worker()
+    nodes = [n for n in _gcs().get_all_nodes() if n.get("alive", True)]
+
+    async def one(n):
+        try:
+            res = await asyncio.wait_for(
+                w._pool.get(*n["address"]).call(method, **kwargs),
+                timeout,
+            )
+            return (n, res)
+        except Exception:
+            return (n, None)
+
+    async def all_():
+        return await asyncio.gather(*(one(n) for n in nodes))
+
+    results = EventLoopThread.get().run(all_(), timeout + 5.0)
+    return [(n, r) for n, r in results if r is not None]
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    out = []
+    for n, info in _fanout_raylets("node_info"):
+        for wid in info.get("workers", []):
+            out.append({"worker_id": wid, "node_id": n["node_id"]})
+    return out
+
+
+def list_objects(limit: int = 10000) -> List[Dict[str, Any]]:
+    """Objects sealed in every node's shm arena. (Inline objects live in
+    their owners' memory stores and are not listed — same as the
+    reference, which lists only plasma-backed objects.)"""
+    out: List[Dict[str, Any]] = []
+    for _, objs in _fanout_raylets("list_store_objects", timeout=10.0,
+                                   limit=limit):
+        out.extend(objs)
+    return out[:limit]
+
+
+def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for t in list_tasks(job_id=job_id, limit=100000):
+        counts[t["state"]] = counts.get(t["state"], 0) + 1
+    return counts
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for a in list_actors():
+        counts[a["state"]] = counts.get(a["state"], 0) + 1
+    return counts
+
+
+def _match(rec: dict, filters: Optional[Dict[str, Any]]) -> bool:
+    if not filters:
+        return True
+    return all(rec.get(k) == v for k, v in filters.items())

@@ -1,0 +1,162 @@
+"""State API + metrics tests.
+
+Reference behaviors mirrored: python/ray/util/state/api.py (`ray list
+actors/tasks/nodes/objects`), util/metrics.py (Counter/Gauge/Histogram),
+_private/metrics_agent.py (node Prometheus scrape).
+"""
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.util import metrics as um
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    os.environ["RAY_TPU_METRICS_REPORT_INTERVAL_S"] = "0.5"
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    ray.shutdown()
+    os.environ.pop("RAY_TPU_METRICS_REPORT_INTERVAL_S", None)
+
+
+@ray.remote
+class Counting:
+    def __init__(self):
+        self.c = um.Counter(
+            "test_user_requests_total", "user counter from an actor"
+        )
+
+    def bump(self, n):
+        self.c.inc(n)
+        return n
+
+
+def test_list_nodes_shows_head(ray_start):
+    nodes = state.list_nodes()
+    assert len(nodes) == 1
+    assert nodes[0]["state"] == "ALIVE"
+    assert nodes[0]["is_head"]
+    assert nodes[0]["resources_total"].get("CPU") == 8
+
+
+def test_list_actors_shows_started_actor(ray_start):
+    a = Counting.options(name="state-test-actor").remote()
+    ray.get(a.bump.remote(1))
+    actors = state.list_actors()
+    match = [x for x in actors if x["name"] == "state-test-actor"]
+    assert len(match) == 1
+    assert match[0]["state"] == "ALIVE"
+    assert match[0]["class_name"] == "Counting"
+    assert match[0]["actor_id"]
+    # summaries count it
+    assert state.summarize_actors().get("ALIVE", 0) >= 1
+
+
+def test_list_tasks_and_summary(ray_start):
+    @ray.remote
+    def stately(x):
+        return x + 1
+
+    refs = [stately.remote(i) for i in range(5)]
+    assert ray.get(refs) == [1, 2, 3, 4, 5]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tasks = state.list_tasks()
+        done = [t for t in tasks
+                if t["name"] == "stately" and t["state"] == "FINISHED"]
+        if len(done) >= 5:
+            break
+        time.sleep(0.3)
+    assert len(done) >= 5
+    assert state.summarize_tasks().get("FINISHED", 0) >= 5
+
+
+def test_list_objects_shows_shm_object(ray_start):
+    big = ray.put(np.zeros(1_000_000, dtype=np.uint8))  # 1 MB -> shm
+    objs = state.list_objects()
+    ids = {o["object_id"] for o in objs}
+    assert big.hex() in ids
+    del big
+
+
+def test_list_workers(ray_start):
+    workers = state.list_workers()
+    assert len(workers) >= 1
+    assert all(w["node_id"] for w in workers)
+
+
+def test_prometheus_scrape(ray_start):
+    a = Counting.remote()
+    ray.get(a.bump.remote(7))
+    nodes = ray.nodes()
+    addr = nodes[0].get("metrics_address")
+    assert addr, "raylet did not start a metrics endpoint"
+    url = f"http://{addr[0]}:{addr[1]}/metrics"
+
+    # worker flush interval is 0.5s; poll the scrape until it shows up
+    def user_counter_lines(text):
+        return [ln for ln in text.splitlines()
+                if ln.startswith("test_user_requests_total")]
+
+    deadline = time.monotonic() + 15
+    text = ""
+    while time.monotonic() < deadline:
+        text = urllib.request.urlopen(url, timeout=5).read().decode()
+        if "ray_tpu_tasks_submitted_total" in text and any(
+            float(ln.rsplit(" ", 1)[1]) >= 7
+            for ln in user_counter_lines(text)
+        ):
+            break
+        time.sleep(0.5)
+    # node-level gauges are rendered at scrape time
+    assert "ray_tpu_node_resource_total" in text
+    assert "ray_tpu_object_store_bytes" in text
+    assert "ray_tpu_workers" in text
+    # core counters flushed from workers/driver
+    assert "ray_tpu_tasks_submitted_total" in text
+    # the user counter from the actor, with its value
+    line = user_counter_lines(text)
+    assert line, text[:2000]
+    assert any(float(ln.rsplit(" ", 1)[1]) >= 7 for ln in line)
+
+
+def test_histogram_renders_buckets():
+    from ray_tpu._private.metrics import (
+        MetricsRegistry,
+        render_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s", "latency", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = render_prometheus([({}, reg.snapshot())])
+    assert 'lat_s_bucket{le="0.1"} 1' in text
+    assert 'lat_s_bucket{le="1.0"} 2' in text
+    assert 'lat_s_bucket{le="+Inf"} 3' in text
+    assert "lat_s_count 3" in text
+
+
+def test_counter_gauge_labels():
+    from ray_tpu._private.metrics import (
+        MetricsRegistry,
+        render_prometheus,
+    )
+
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total")
+    c.inc(1, {"route": "/a"})
+    c.inc(2, {"route": "/a"})
+    c.inc(5, {"route": "/b"})
+    reg.gauge("temp").set(3.5)
+    text = render_prometheus([({"node": "n1"}, reg.snapshot())])
+    assert 'reqs_total{node="n1",route="/a"} 3.0' in text
+    assert 'reqs_total{node="n1",route="/b"} 5.0' in text
+    assert 'temp{node="n1"} 3.5' in text
