@@ -1,0 +1,4 @@
+"""``python -m ray_tpu`` → the cluster CLI (scripts/cli.py)."""
+from .scripts.cli import main
+
+main()

@@ -404,6 +404,16 @@ class CoreWorker:
 
     def shutdown(self):
         self._exit.set()
+        if self._cfg.metrics_export_port >= 0:
+            try:
+                from .metrics import get_registry
+
+                self.raylet.call_sync(
+                    "report_metrics", worker_id=self.worker_id,
+                    snapshot=get_registry().snapshot(), timeout=2.0,
+                )
+            except Exception:
+                pass
         self._flush_pending_frees()
         try:
             EventLoopThread.get().run(self._server.stop(), 5.0)
@@ -488,8 +498,11 @@ class CoreWorker:
         if self._cfg.metrics_export_port < 0:
             return  # export disabled: don't ship unscrapeable snapshots
         interval = max(0.5, self._cfg.metrics_report_interval_s)
+        first = True
         while not self._exit.is_set():
-            await asyncio.sleep(interval)
+            # early first report so short-lived processes still export
+            await asyncio.sleep(min(1.0, interval) if first else interval)
+            first = False
             try:
                 await self.raylet.call(
                     "report_metrics",

@@ -153,7 +153,10 @@ def render_prometheus(snapshots: List[Tuple[Dict[str, str], List[dict]]]
     for name, series in sorted(by_name.items()):
         kind, desc = meta[name]
         if desc:
-            lines.append(f"# HELP {name} {desc}")
+            lines.append(
+                f"# HELP {name} "
+                + str(desc).replace("\\", "\\\\").replace("\n", "\\n")
+            )
         lines.append(f"# TYPE {name} {kind}")
         for labels, value, boundaries in series:
             lab = ",".join(

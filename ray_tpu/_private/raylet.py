@@ -920,6 +920,11 @@ class Raylet:
             # missing aiohttp, ...): run without a scrape endpoint
             print(f"[raylet] metrics endpoint disabled: {e}", flush=True)
             self.metrics_address = None
+            try:
+                if "runner" in locals():
+                    await runner.cleanup()
+            except Exception:
+                pass
 
     def _render_metrics(self) -> str:
         from .metrics import MetricsRegistry, render_prometheus

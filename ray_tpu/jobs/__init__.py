@@ -1,0 +1,232 @@
+"""Job submission: run entrypoint scripts on the cluster as supervised
+subprocesses.
+
+Reference: python/ray/dashboard/modules/job/ — JobSubmissionClient
+(sdk.py:126), JobManager (job_manager.py:60), JobSupervisor actor
+(job_supervisor.py:55) running the entrypoint as a subprocess with log
+capture; job state in GCS KV.
+
+Shape here: submit_job() starts a detached JobSupervisor actor (so it
+outlives the submitting client); the supervisor runs the entrypoint
+shell command, streams combined stdout/stderr to a log file in its
+node's session dir, and writes status records to the GCS KV under the
+"job_submissions" namespace. Clients poll status from the KV and fetch
+logs from the supervisor.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+KV_NS = "job_submissions"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class JobSupervisor:
+    """Detached actor: one per submitted job (reference:
+    job_supervisor.py:55)."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 runtime_env: Optional[dict] = None):
+        from ray_tpu._private.core_worker import global_worker
+
+        self._worker = global_worker()
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.runtime_env = runtime_env or {}
+        self.log_path = os.path.join(
+            self._worker.session_dir, "logs",
+            f"job-{submission_id}.log",
+        )
+        self._proc: Optional[subprocess.Popen] = None
+        self._update(JobStatus.PENDING)
+
+    def _update(self, status: str, **extra):
+        rec = {
+            "submission_id": self.submission_id,
+            "entrypoint": self.entrypoint,
+            "status": status,
+            "time": time.time(),
+            "log_path": self.log_path,
+            **extra,
+        }
+        self._worker.gcs.kv_put(
+            ns=KV_NS, key=self.submission_id,
+            value=json.dumps(rec).encode(),
+        )
+
+    def run(self) -> bool:
+        """Start the entrypoint; a waiter thread records the outcome."""
+        env = dict(os.environ)
+        env.update(self.runtime_env.get("env_vars", {}))
+        env["RAY_TPU_JOB_SUBMISSION_ID"] = self.submission_id
+        # let the entrypoint script ray_tpu.init(address=...) trivially
+        gcs = self._worker.gcs_address
+        env["RAY_TPU_ADDRESS"] = f"{gcs[0]}:{gcs[1]}"
+        cwd = self.runtime_env.get("working_dir") or None
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        logf = open(self.log_path, "ab")
+        try:
+            self._proc = subprocess.Popen(
+                self.entrypoint, shell=True, stdout=logf,
+                stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                start_new_session=True,
+            )
+        except Exception as e:
+            logf.close()
+            self._update(JobStatus.FAILED, message=str(e))
+            return False
+        self._update(JobStatus.RUNNING, pid=self._proc.pid,
+                     start_time=time.time())
+
+        def wait():
+            rc = self._proc.wait()
+            logf.close()
+            if rc == 0:
+                self._update(JobStatus.SUCCEEDED, returncode=0,
+                             end_time=time.time())
+            elif rc in (-15, -9):
+                self._update(JobStatus.STOPPED, returncode=rc,
+                             end_time=time.time())
+            else:
+                self._update(JobStatus.FAILED, returncode=rc,
+                             end_time=time.time())
+            # self-terminate after a grace window (status lives in the
+            # GCS KV; logs stay on disk for the file fallback) so
+            # supervisors don't accumulate one worker per submission —
+            # the reference's JobSupervisor likewise exits with the job
+            threading.Timer(30.0, os._exit, args=(0,)).start()
+
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        return True
+
+    def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            # the entrypoint runs in its own session: signal the whole
+            # process group, not just the shell
+            import signal as _signal
+
+            try:
+                os.killpg(os.getpgid(self._proc.pid), _signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                self._proc.terminate()
+            return True
+        return False
+
+    def logs(self, tail_bytes: int = 1 << 20) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def ping(self) -> str:
+        return "pong"
+
+
+class JobSubmissionClient:
+    """Reference: python/ray/dashboard/modules/job/sdk.py:126 — here the
+    client IS a (lightweight) driver on the cluster."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu as ray
+
+        if not ray.is_initialized():
+            ray.init(address=address)
+        self._ray = ray
+        from ray_tpu._private.core_worker import global_worker
+
+        self._gcs = global_worker().gcs
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> str:
+        submission_id = submission_id or f"job-{uuid.uuid4().hex[:10]}"
+        Supervisor = self._ray.remote(JobSupervisor)
+        sup = Supervisor.options(
+            name=f"_job_supervisor:{submission_id}",
+            lifetime="detached",
+            num_cpus=0,
+        ).remote(submission_id, entrypoint, runtime_env)
+        ok = self._ray.get(sup.run.remote(), timeout=60)
+        if not ok:
+            raise RuntimeError(
+                f"job {submission_id} failed to start: "
+                f"{self.get_job_info(submission_id)}"
+            )
+        return submission_id
+
+    def _supervisor(self, submission_id: str):
+        return self._ray.get_actor(f"_job_supervisor:{submission_id}")
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        raw = self._gcs.kv_get(ns=KV_NS, key=submission_id)
+        if raw is None:
+            raise ValueError(f"no such job {submission_id}")
+        return json.loads(raw)
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self.get_job_info(submission_id)["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        try:
+            sup = self._supervisor(submission_id)
+            return self._ray.get(sup.logs.remote(), timeout=30)
+        except ValueError:
+            # supervisor gone (terminal job): read the log path directly
+            # if it is on this node
+            info = self.get_job_info(submission_id)
+            try:
+                with open(info["log_path"]) as f:
+                    return f.read()
+            except OSError:
+                return ""
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        out = []
+        for key in self._gcs.kv_keys(ns=KV_NS):
+            raw = self._gcs.kv_get(ns=KV_NS, key=key)
+            if raw:
+                out.append(json.loads(raw))
+        return out
+
+    def stop_job(self, submission_id: str) -> bool:
+        try:
+            sup = self._supervisor(submission_id)
+        except ValueError:
+            return False
+        return self._ray.get(sup.stop.remote(), timeout=30)
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.5)
+        raise TimeoutError(
+            f"job {submission_id} not finished after {timeout}s"
+        )

@@ -1,0 +1,292 @@
+"""ray-tpu CLI: start / stop / status / submit / jobs / timeline /
+microbenchmark.
+
+Reference: python/ray/scripts/scripts.py — `ray start` (:677), `ray stop`,
+`ray status` (:2124), `ray timeline` (:2026), `ray microbenchmark`
+(:2012), plus the job CLI from dashboard/modules/job/cli.py.
+
+Invoke as ``python -m ray_tpu <command>``. Cluster bookkeeping lives in
+<session_dir_root>/current_cluster.json so stop/status/submit find the
+running cluster without flags.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import Optional, Tuple
+
+
+def _cluster_file() -> str:
+    from ray_tpu._private.config import get_config
+
+    root = get_config().session_dir_root
+    os.makedirs(root, exist_ok=True)
+    return os.path.join(root, "current_cluster.json")
+
+
+def _load_cluster() -> Optional[dict]:
+    try:
+        with open(_cluster_file()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _resolve_address(args) -> Tuple[str, int]:
+    addr = getattr(args, "address", None) or os.environ.get(
+        "RAY_TPU_ADDRESS")
+    if addr:
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+    info = _load_cluster()
+    if info:
+        return tuple(info["gcs_address"])
+    sys.exit(
+        "error: no running cluster found — pass --address or run "
+        "`python -m ray_tpu start --head` first"
+    )
+
+
+# ---------------------------------------------------------------------------
+def cmd_start(args):
+    from ray_tpu._private import node as node_mod
+
+    if args.head:
+        node = node_mod.Node(
+            head=True,
+            resources=json.loads(args.resources) if args.resources else None,
+        )
+    else:
+        host, port = _resolve_address(args)
+        node = node_mod.Node(
+            head=False,
+            gcs_address=(host, port),
+            resources=json.loads(args.resources) if args.resources else None,
+        )
+    # the CLI exits but the node must keep running: detach lifecycle
+    import atexit
+
+    atexit.unregister(node.shutdown)
+    pids = [p.pid for p in node._procs]
+    info = {
+        "gcs_address": list(node.gcs_address),
+        "session_dir": node.session_dir,
+        "node_id": node.node_id,
+        "pids": pids,
+        "is_head": node.is_head,
+    }
+    if args.head:
+        with open(_cluster_file(), "w") as f:
+            json.dump(info, f)
+    addr = f"{node.gcs_address[0]}:{node.gcs_address[1]}"
+    print(f"ray_tpu {'head' if args.head else 'worker'} node started.")
+    print(f"  address:     {addr}")
+    print(f"  session dir: {node.session_dir}")
+    print(f"  connect:     ray_tpu.init(address=\"{addr}\")")
+    if args.block:
+        try:
+            while all(_alive(p) for p in pids):
+                time.sleep(1.0)
+        except KeyboardInterrupt:
+            _stop_pids(pids)
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _stop_pids(pids):
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(_alive(p) for p in pids):
+        time.sleep(0.1)
+    for pid in pids:
+        if _alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def cmd_stop(args):
+    info = _load_cluster()
+    if not info:
+        print("no recorded cluster; nothing to stop")
+        return
+    _stop_pids(info.get("pids", []))
+    try:
+        os.unlink(_cluster_file())
+    except OSError:
+        pass
+    print("ray_tpu cluster stopped.")
+
+
+def cmd_status(args):
+    from ray_tpu._private.gcs import GcsClient
+
+    host, port = _resolve_address(args)
+    gcs = GcsClient(host, port)
+    try:
+        status = gcs.get_cluster_status(timeout=10.0)
+    finally:
+        gcs.close()
+    up = int(status.get("uptime_s", 0))
+    print(f"cluster at {host}:{port} — up {up // 3600}h"
+          f"{(up % 3600) // 60:02d}m{up % 60:02d}s")
+    nodes = status.get("nodes", [])
+    alive = [n for n in nodes if n.get("alive", True)]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    for n in alive:
+        total = n.get("total", {})
+        avail = n.get("available", {})
+        res = ", ".join(
+            f"{k} {avail.get(k, 0):g}/{v:g}" for k, v in sorted(
+                total.items()) if k != "memory"
+        )
+        head = " (head)" if n.get("is_head") else ""
+        print(f"  {n['node_id'][:12]}{head}: {res}")
+    print(f"actors: {status.get('num_actors', 0)} "
+          f"(pending {status.get('num_pending_actors', 0)}), "
+          f"placement groups: {status.get('num_pgs', 0)}")
+    jobs = status.get("jobs", [])
+    if jobs:
+        print(f"driver jobs: {len(jobs)}")
+
+
+def cmd_submit(args):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    if not args.entrypoint:
+        sys.exit("error: no entrypoint given — usage: "
+                 "submit [opts] -- <command> [args...]")
+    host, port = _resolve_address(args)
+    client = JobSubmissionClient(f"{host}:{port}")
+    entrypoint = " ".join(args.entrypoint)
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    sid = client.submit_job(entrypoint=entrypoint,
+                            runtime_env=runtime_env or None)
+    print(f"submitted: {sid}")
+    if args.wait or args.follow:
+        status = client.wait_until_finished(sid, timeout=args.timeout)
+        if args.follow:
+            sys.stdout.write(client.get_job_logs(sid))
+        print(f"job {sid}: {status}")
+        if status != "SUCCEEDED":
+            sys.exit(1)
+
+
+def cmd_jobs(args):
+    from ray_tpu.jobs import JobSubmissionClient
+
+    host, port = _resolve_address(args)
+    client = JobSubmissionClient(f"{host}:{port}")
+    if args.job_cmd == "list":
+        for j in sorted(client.list_jobs(), key=lambda j: j.get("time", 0)):
+            print(f"{j['submission_id']}  {j['status']:10s}  "
+                  f"{j['entrypoint']}")
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "stop":
+        ok = client.stop_job(args.job_id)
+        print("stopped" if ok else "not running")
+
+
+def cmd_timeline(args):
+    import ray_tpu as ray
+
+    host, port = _resolve_address(args)
+    ray.init(address=f"{host}:{port}")
+    events = ray.timeline()
+    with open(args.output, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
+def cmd_microbenchmark(args):
+    from ray_tpu.microbenchmark import main as bench_main
+
+    bench_main()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu",
+        description="ray_tpu cluster CLI (reference: ray start/stop/...)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a head or worker node")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", help="GCS address to join (worker nodes)")
+    s.add_argument("--resources", help='JSON, e.g. \'{"CPU": 8}\'')
+    s.add_argument("--block", action="store_true",
+                   help="stay attached; ctrl-c stops the node")
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("stop", help="stop the recorded local cluster")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("status", help="show cluster status")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("submit", help="submit a job entrypoint")
+    s.add_argument("--address")
+    s.add_argument("--working-dir")
+    s.add_argument("--wait", action="store_true")
+    s.add_argument("--follow", action="store_true",
+                   help="wait and print the job log")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                   help="command to run, e.g. -- python train.py")
+    s.set_defaults(fn=cmd_submit)
+
+    s = sub.add_parser("jobs", help="job management")
+    s.add_argument("--address")
+    jsub = s.add_subparsers(dest="job_cmd", required=True)
+    jsub.add_parser("list")
+    js = jsub.add_parser("status")
+    js.add_argument("job_id")
+    js = jsub.add_parser("logs")
+    js.add_argument("job_id")
+    js = jsub.add_parser("stop")
+    js.add_argument("job_id")
+    s.set_defaults(fn=cmd_jobs)
+
+    s = sub.add_parser("timeline", help="export chrome-trace task events")
+    s.add_argument("--address")
+    s.add_argument("--output", default="timeline.json")
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("microbenchmark",
+                       help="run the core perf suite")
+    s.set_defaults(fn=cmd_microbenchmark)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    # strip a leading "--" from REMAINDER entrypoints
+    if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
