@@ -1497,6 +1497,10 @@ class CoreWorker:
         )
 
     def _execute_actor_creation(self, actor_id: str, creation_task: bytes):
+        if self.actor_id == actor_id and self.actor_instance is not None:
+            # idempotent: a restarted GCS may re-push the creation it
+            # cannot prove landed (gcs.py _post_restore_reconcile)
+            return {"ok": True, "address": list(self.address)}
         info = cloudpickle.loads(creation_task)
         cls = cloudpickle.loads(info["cls"])
         args = [self._unpack_arg(a) for a in info["args"]]

@@ -48,7 +48,8 @@ DEAD = "DEAD"
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self._server = RpcServer(host, port)
         self._server.register(self)
         self._pool = ClientPool()
@@ -88,6 +89,136 @@ class GcsServer:
         self._started = time.time()
         self._bg_tasks: List[asyncio.Task] = []
 
+        # --- persistence (reference: gcs/store_client/redis_store_client
+        # gives the reference GCS restartability; here: a debounced
+        # atomic snapshot of the durable tables — actors/PGs/jobs/KV.
+        # Nodes are deliberately NOT persisted: raylets re-register on
+        # their next heartbeat after a restart.)
+        self._persist_path = persist_path
+        self._dirty = asyncio.Event()
+        self._restored = False
+        if persist_path and os.path.exists(persist_path):
+            self._load_snapshot(persist_path)
+
+    def _load_snapshot(self, path: str):
+        import pickle
+
+        try:
+            with open(path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception as e:
+            print(f"[gcs] failed to load snapshot {path}: {e}",
+                  flush=True)
+            return
+        self._actors.update(snap.get("actors", {}))
+        self._named_actors.update(snap.get("named_actors", {}))
+        self._pgs.update(snap.get("pgs", {}))
+        self._jobs.update(snap.get("jobs", {}))
+        for ns, table in snap.get("kv", {}).items():
+            self._kv[ns].update(table)
+        # resume interrupted scheduling work. Actors with an assigned
+        # worker address were mid-push when the GCS died: the creation
+        # may already have landed, so they go through the reconcile pass
+        # (idempotent re-push to the same worker) instead of a fresh
+        # lease, which would double-create the actor.
+        self._restored = True
+        for aid, rec in self._actors.items():
+            if rec["state"] in (PENDING_CREATION, RESTARTING):
+                if not rec.get("address"):
+                    self._pending_actors.append(aid)
+        for pgid, pg in self._pgs.items():
+            if pg["state"] in ("PENDING", "RESCHEDULING"):
+                self._pending_pgs.append(pgid)
+        print(
+            f"[gcs] restored snapshot: {len(self._actors)} actors, "
+            f"{len(self._pgs)} pgs, {len(self._jobs)} jobs",
+            flush=True,
+        )
+
+    def _mark_dirty(self):
+        if self._persist_path:
+            self._dirty.set()
+
+    def _persist_now(self):
+        """Synchronous atomic snapshot write."""
+        import pickle
+
+        try:
+            data = pickle.dumps({
+                "actors": self._actors,
+                "named_actors": self._named_actors,
+                "pgs": self._pgs,
+                "jobs": self._jobs,
+                "kv": {ns: dict(t) for ns, t in self._kv.items()},
+            })
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, self._persist_path)
+        except Exception as e:  # noqa: BLE001 — persistence must not
+            # take the control plane down; stale snapshots are logged
+            print(f"[gcs] snapshot write failed: {e}", flush=True)
+
+    async def _persist_loop(self):
+        """Debounced atomic snapshots: coalesces bursts, loses at most
+        ~50ms of mutations on kill -9 (the Redis-backed reference is
+        per-mutation durable; this is the documented tradeoff of the
+        file backend)."""
+        while True:
+            await self._dirty.wait()
+            await asyncio.sleep(0.05)
+            self._dirty.clear()
+            self._persist_now()
+
+    async def _post_restore_reconcile(self):
+        """After a restart: (a) idempotently re-push creations that were
+        in flight when the old GCS died; (b) after a re-registration
+        grace window, declare actors/PGs on nodes that never came back."""
+        # (a) in-flight creations: the worker answers idempotently if the
+        # first push already landed
+        for aid, rec in list(self._actors.items()):
+            if rec["state"] not in (PENDING_CREATION, RESTARTING):
+                continue
+            addr = rec.get("address")
+            if not addr:
+                continue
+            try:
+                worker = self._pool.get(*addr)
+                await worker.call(
+                    "push_actor_creation", actor_id=aid,
+                    creation_task=rec["creation_task"], timeout=15.0,
+                )
+                rec["state"] = ALIVE
+                self._mark_dirty()
+                self._publish("ACTOR", {
+                    "event": "alive", "actor_id": aid,
+                    "address": tuple(addr),
+                    "node_id": rec.get("node_id"),
+                })
+            except Exception:
+                rec["address"] = None
+                self._requeue_actor(aid)
+        # (b) wait out one full re-registration window, then sweep
+        await asyncio.sleep(self._hb_period * self._hb_threshold + 2.0)
+        alive_nodes = {nid for nid, v in self._node_views.items()
+                       if v.alive}
+        for aid, rec in list(self._actors.items()):
+            if rec["state"] == ALIVE and \
+                    rec.get("node_id") not in alive_nodes:
+                self._on_actor_interrupted(
+                    aid,
+                    f"node {rec.get('node_id')} did not re-register "
+                    f"after GCS restart",
+                )
+        for pgid, pg in self._pgs.items():
+            placement = pg.get("placement") or []
+            if pg["state"] == "CREATED" and any(
+                    n not in alive_nodes for n in placement):
+                pg["state"] = "RESCHEDULING"
+                self._mark_dirty()
+                self._pending_pgs.append(pgid)
+        self._kick_schedulers()
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -95,10 +226,21 @@ class GcsServer:
         await self._server.start()
         self._bg_tasks.append(asyncio.ensure_future(self._health_check_loop()))
         self._bg_tasks.append(asyncio.ensure_future(self._scheduling_loop()))
+        if self._persist_path:
+            self._bg_tasks.append(
+                asyncio.ensure_future(self._persist_loop())
+            )
+        if self._restored:
+            self._bg_tasks.append(
+                asyncio.ensure_future(self._post_restore_reconcile())
+            )
 
     async def stop(self):
         for t in self._bg_tasks:
             t.cancel()
+        if self._persist_path and self._dirty.is_set():
+            # graceful shutdown flushes the last debounce window
+            self._persist_now()
         await self._server.stop()
 
     @property
@@ -153,6 +295,7 @@ class GcsServer:
         if not overwrite and key in table:
             return False
         table[key] = value
+        self._mark_dirty()
         return True
 
     async def kv_get(self, ns: str, key: str):
@@ -163,7 +306,10 @@ class GcsServer:
         return {k: table[k] for k in keys if k in table}
 
     async def kv_del(self, ns: str, key: str):
-        return self._kv[ns].pop(key, None) is not None
+        existed = self._kv[ns].pop(key, None) is not None
+        if existed:
+            self._mark_dirty()
+        return existed
 
     async def kv_exists(self, ns: str, key: str):
         return key in self._kv[ns]
@@ -271,6 +417,7 @@ class GcsServer:
         for pgid, pg in self._pgs.items():
             if pg["state"] == "CREATED" and node_id in (pg.get("placement") or []):
                 pg["state"] = "RESCHEDULING"
+                self._mark_dirty()
                 self._pending_pgs.append(pgid)
         self._kick_schedulers()
 
@@ -283,6 +430,7 @@ class GcsServer:
     async def add_job(self, job_info: dict):
         self._jobs[job_info["job_id"]] = {**job_info, "state": "RUNNING",
                                           "start_time": time.time()}
+        self._mark_dirty()
         self._publish("JOB", {"event": "added", "job": job_info})
         return True
 
@@ -290,6 +438,7 @@ class GcsServer:
         job = self._jobs.get(job_id)
         if job is not None:
             job["state"] = "FINISHED"
+            self._mark_dirty()
             job["end_time"] = time.time()
         # Kill non-detached actors belonging to the job.
         for aid, rec in list(self._actors.items()):
@@ -329,6 +478,7 @@ class GcsServer:
         }
         self._actors[aid] = rec
         self._pending_actors.append(aid)
+        self._mark_dirty()
         self._kick_schedulers()
         return {"ok": True}
 
@@ -442,6 +592,11 @@ class GcsServer:
             worker_id=lease["worker_id"],
             address=worker_addr,
         )
+        if self._persist_path:
+            # durable BEFORE the push: a GCS crash mid-creation must
+            # restore the assigned worker so reconcile re-pushes to the
+            # same process (idempotent) instead of double-creating
+            self._persist_now()
         await self._finish_actor_creation(aid, rec, raylet, lease,
                                           worker_addr, node_id)
 
@@ -451,6 +606,7 @@ class GcsServer:
         if rec is None or rec["state"] == DEAD:
             return
         rec["state"] = DEAD
+        self._mark_dirty()
         rec["death_cause"] = reason
         self._publish("ACTOR", {"event": "dead", "actor_id": aid,
                                 "reason": reason})
@@ -482,6 +638,7 @@ class GcsServer:
         if rec["state"] == DEAD:
             return  # killed while constructing
         rec["state"] = ALIVE
+        self._mark_dirty()
         self._publish("ACTOR", {"event": "alive", "actor_id": aid,
                                 "address": worker_addr,
                                 "node_id": node_id})
@@ -494,6 +651,7 @@ class GcsServer:
         if max_restarts == -1 or rec["restarts"] < max_restarts:
             rec["restarts"] += 1
             rec["state"] = RESTARTING
+            self._mark_dirty()
             rec["address"] = None
             self._publish("ACTOR", {"event": "restarting", "actor_id": aid,
                                     "reason": reason})
@@ -501,6 +659,7 @@ class GcsServer:
             self._kick_schedulers()
         else:
             rec["state"] = DEAD
+            self._mark_dirty()
             rec["death_cause"] = reason
             self._publish("ACTOR", {"event": "dead", "actor_id": aid,
                                     "reason": reason})
@@ -512,6 +671,7 @@ class GcsServer:
             return False
         if expected:
             rec["state"] = DEAD
+            self._mark_dirty()
             rec["death_cause"] = reason
             self._publish("ACTOR", {"event": "dead", "actor_id": actor_id,
                                     "reason": reason})
@@ -574,11 +734,13 @@ class GcsServer:
             except Exception:
                 pass
             rec["state"] = DEAD
+            self._mark_dirty()
             rec["death_cause"] = reason
             self._publish("ACTOR", {"event": "dead", "actor_id": actor_id,
                                     "reason": reason})
         elif no_restart:
             rec["state"] = DEAD
+            self._mark_dirty()
             rec["death_cause"] = reason
             self._publish("ACTOR", {"event": "dead", "actor_id": actor_id,
                                     "reason": reason})
@@ -597,6 +759,7 @@ class GcsServer:
             "placement": None,
         }
         self._pending_pgs.append(pgid)
+        self._mark_dirty()
         self._kick_schedulers()
         return {"ok": True}
 
@@ -642,6 +805,7 @@ class GcsServer:
                 pass
         pg["placement"] = placement
         pg["state"] = "CREATED"
+        self._mark_dirty()
         self._publish("PG", {"event": "created", "pg_id": pgid,
                              "placement": placement})
         self._kick_schedulers()  # unblock actors waiting on this PG
@@ -663,6 +827,7 @@ class GcsServer:
                 except Exception:
                     pass
         pg["state"] = "REMOVED"
+        self._mark_dirty()
         self._publish("PG", {"event": "removed", "pg_id": pg_id})
         return True
 
@@ -740,12 +905,14 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--config", default=None)
+    parser.add_argument("--persist-path", default=None)
     args = parser.parse_args()
     if args.config:
         set_config(Config.from_json(args.config))
 
     async def run():
-        server = GcsServer(args.host, args.port)
+        server = GcsServer(args.host, args.port,
+                           persist_path=args.persist_path)
         await server.start()
         print(f"GCS listening on {server.address}", flush=True)
         await asyncio.Event().wait()

@@ -59,6 +59,9 @@ def start_gcs_server(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen,
             sys.executable, "-m", "ray_tpu._private.gcs",
             "--port", str(port),
             "--config", get_config().to_json(),
+            # durable actor/PG/job/KV tables: a restarted GCS (same
+            # session) restores them (reference: redis_store_client.cc)
+            "--persist-path", os.path.join(session_dir, "gcs_state.pkl"),
         ],
         stdout=subprocess.PIPE,
         stderr=log,

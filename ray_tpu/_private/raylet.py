@@ -216,27 +216,31 @@ class Raylet:
             pass
 
     # ------------------------------------------------------------------
+    def _registration_info(self) -> dict:
+        """The node-table record; used by initial registration and by
+        heartbeat-driven re-registration after a GCS restart."""
+        return {
+            "node_id": self.node_id,
+            "address": list(self.address),
+            "object_manager_address": list(self.address),
+            "arena_path": self.arena_path,
+            "resources": self.total,
+            "labels": self.labels,
+            "is_head": self.is_head,
+            "session_dir": self.session_dir,
+            "pid": os.getpid(),
+            "metrics_address": (
+                list(self.metrics_address)
+                if self.metrics_address else None
+            ),
+        }
+
     async def start(self):
         await self._server.start()
         self.address = self._server.address
         await self._start_metrics_endpoint()
         await self.gcs.aio.call(
-            "register_node",
-            info={
-                "node_id": self.node_id,
-                "address": list(self.address),
-                "object_manager_address": list(self.address),
-                "arena_path": self.arena_path,
-                "resources": self.total,
-                "labels": self.labels,
-                "is_head": self.is_head,
-                "session_dir": self.session_dir,
-                "pid": os.getpid(),
-                "metrics_address": (
-                    list(self.metrics_address)
-                    if self.metrics_address else None
-                ),
-            },
+            "register_node", info=self._registration_info()
         )
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._lease_grant_loop()))
@@ -283,18 +287,7 @@ class Raylet:
                 if view is None:
                     # GCS restarted and lost us: re-register.
                     await self.gcs.aio.call(
-                        "register_node",
-                        info={
-                            "node_id": self.node_id,
-                            "address": list(self.address),
-                            "object_manager_address": list(self.address),
-                            "arena_path": self.arena_path,
-                            "resources": self.total,
-                            "labels": self.labels,
-                            "is_head": self.is_head,
-                            "session_dir": self.session_dir,
-                            "pid": os.getpid(),
-                        },
+                        "register_node", info=self._registration_info()
                     )
                 else:
                     self._update_view(view)

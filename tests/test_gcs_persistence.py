@@ -1,0 +1,119 @@
+"""GCS persistence + restart recovery tests.
+
+VERDICT item 9 'done' bar: kill -9 the GCS mid-run, restart it, and a
+detached named actor is still reachable. Reference:
+gcs/store_client/redis_store_client.cc + gcs_client_reconnection_test.
+"""
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import api as ray_api
+from ray_tpu._private import node as node_mod
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 4, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+@ray.remote
+class KeepAlive:
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+def _restart_gcs():
+    """kill -9 the GCS process and start a replacement on the same port
+    with the same persistence path."""
+    node = ray_api._node
+    port = node.gcs_address[1]
+    old = node.gcs_proc
+    os.kill(old.pid, signal.SIGKILL)
+    old.wait()
+    # replacement on the same port, same session dir -> same snapshot
+    proc, addr = node_mod.start_gcs_server(node.session_dir, port=port)
+    node.gcs_proc = proc
+    node._procs.append(proc)
+    return addr
+
+
+def test_detached_actor_survives_gcs_restart(ray_start):
+    a = KeepAlive.options(
+        name="persist-me", lifetime="detached"
+    ).remote()
+    assert ray.get(a.bump.remote(), timeout=60) == 1
+    time.sleep(0.5)  # persistence debounce window
+
+    _restart_gcs()
+
+    # the raylet re-registers on its next heartbeat; the actor table came
+    # back from the snapshot — named lookup + calls must work
+    deadline = time.monotonic() + 30
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            h = ray.get_actor("persist-me")
+            assert ray.get(h.bump.remote(), timeout=10) == 2
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(0.5)
+    else:
+        raise AssertionError(f"actor unreachable after restart: {last_err}")
+
+
+def test_kv_and_jobs_survive_gcs_restart(ray_start):
+    w = ray_api.global_worker()
+    w.gcs.kv_put(ns="persist_test", key="k1", value=b"v1")
+    pg = ray.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    time.sleep(0.5)
+
+    _restart_gcs()
+    time.sleep(1.0)
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert w.gcs.kv_get(ns="persist_test", key="k1") == b"v1"
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("kv not restored")
+    # the PG table survived
+    table = ray.placement_group_table()
+    states = {p["state"] for p in table.values()} if isinstance(
+        table, dict) else {p["state"] for p in table}
+    assert "CREATED" in states
+    ray.remove_placement_group(pg)
+
+
+def test_tasks_still_run_after_gcs_restart(ray_start):
+    @ray.remote
+    def f(x):
+        return x + 10
+
+    assert ray.get(f.remote(1), timeout=60) == 11
+    _restart_gcs()
+    time.sleep(1.5)
+    # normal task submission (lease via raylet) works post-restart
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert ray.get(f.remote(2), timeout=10) == 12
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("tasks broken after GCS restart")
