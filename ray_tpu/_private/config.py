@@ -68,6 +68,14 @@ class Config:
     default_actor_max_restarts: int = 0
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5
+    # Node OOM defense (reference: memory_monitor.h:52 +
+    # worker_killing_policy.h:39). usage fraction above which the newest
+    # retriable task's worker is killed; <= 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_s: float = 1.0
+    # Idle TTL for runtime-env-specific workers (vanilla pool workers
+    # are never culled; reference: worker_pool.cc idle eviction).
+    runtime_env_worker_ttl_s: float = 60.0
     # lineage reconstruction
     enable_lineage_reconstruction: bool = True
     max_lineage_bytes: int = 256 * 1024 * 1024

@@ -335,6 +335,7 @@ class CoreWorker:
 
         # actor-creation args pinned until the actor dies (by actor_id hex)
         self._creation_retained: Dict[str, list] = {}
+        self._creation_mutex = threading.Lock()
 
         # blocked-in-get depth (worker mode): CPU release bookkeeping
         self._block_depth = 0
@@ -1045,6 +1046,7 @@ class CoreWorker:
         serialized_func: Optional[bytes] = None,
         func_refs: Sequence["ObjectRef"] = (),
         tensor_transport: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
     ) -> List[ObjectRef]:
         self._task_counter += 1
         task_id = TaskID.for_job(self.job_id)
@@ -1075,6 +1077,8 @@ class CoreWorker:
         }
         if tensor_transport:
             spec["tensor_transport"] = tensor_transport
+        if runtime_env:
+            spec["runtime_env"] = runtime_env
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
@@ -1095,7 +1099,8 @@ class CoreWorker:
         self._record_task_event(spec, "PENDING")
         self._count("ray_tpu_tasks_submitted_total",
                     "tasks submitted by this worker")
-        pool = self._lease_pool(demand, strategy, strategy_params)
+        pool = self._lease_pool(demand, strategy, strategy_params,
+                                runtime_env)
         pool.enqueue(spec)
         return [
             ObjectRef(oid, self.address, _register=False)
@@ -1155,7 +1160,10 @@ class CoreWorker:
                 refs.append(r)
         return packed_args, packed_kwargs, refs
 
-    def _lease_pool(self, demand, strategy, strategy_params) -> "_LeasePool":
+    def _lease_pool(self, demand, strategy, strategy_params,
+                    runtime_env: Optional[dict] = None) -> "_LeasePool":
+        import json as _json
+
         params = strategy_params or {}
         key = (
             tuple(sorted(demand.items())),
@@ -1163,11 +1171,14 @@ class CoreWorker:
             params.get("placement_group_id"),
             params.get("bundle_index", -1),
             params.get("node_id"),
+            _json.dumps(runtime_env, sort_keys=True) if runtime_env
+            else None,
         )
         with self._sched_lock:
             pool = self._sched_classes.get(key)
             if pool is None:
-                pool = _LeasePool(self, demand, strategy, params)
+                pool = _LeasePool(self, demand, strategy, params,
+                                  runtime_env)
                 self._sched_classes[key] = pool
             return pool
 
@@ -1253,7 +1264,8 @@ class CoreWorker:
         if task.is_actor:
             return False  # actor results are not reconstructable
         pool = self._lease_pool(
-            spec["demand"], spec["strategy"], spec["strategy_params"]
+            spec["demand"], spec["strategy"], spec["strategy_params"],
+            spec.get("runtime_env"),
         )
         pool.enqueue(spec)
         return True
@@ -1497,6 +1509,15 @@ class CoreWorker:
         )
 
     def _execute_actor_creation(self, actor_id: str, creation_task: bytes):
+        # serialize creations: a reconcile re-push arriving while the
+        # original constructor is still running must wait for it, not
+        # run the constructor a second time
+        with self._creation_mutex:
+            return self._execute_actor_creation_locked(
+                actor_id, creation_task)
+
+    def _execute_actor_creation_locked(self, actor_id: str,
+                                       creation_task: bytes):
         if self.actor_id == actor_id and self.actor_instance is not None:
             # idempotent: a restarted GCS may re-push the creation it
             # cannot prove landed (gcs.py _post_restore_reconcile)
@@ -2067,11 +2088,13 @@ class CoreWorker:
 class _LeasePool:
     MAX_LEASES_PER_CLASS = int(os.environ.get("RAY_TPU_MAX_LEASES", "64"))
 
-    def __init__(self, worker: CoreWorker, demand, strategy, params):
+    def __init__(self, worker: CoreWorker, demand, strategy, params,
+                 runtime_env=None):
         self.worker = worker
         self.demand = demand
         self.strategy = strategy
         self.params = params or {}
+        self.runtime_env = runtime_env
         self.queue: collections.deque = collections.deque()
         self.free_leases: collections.deque = collections.deque()
         self.num_leases = 0
@@ -2253,6 +2276,7 @@ class _LeasePool:
                 "lease_worker",
                 demand=self.demand,
                 lease_type="task",
+                runtime_env=self.runtime_env,
                 placement_group_id=self.params.get("placement_group_id"),
                 bundle_index=self.params.get("bundle_index", -1),
                 allow_spill=allow_spill,
@@ -2263,6 +2287,13 @@ class _LeasePool:
                 self.pending_lease_requests -= 1
             await asyncio.sleep(0.2)
             asyncio.ensure_future(self._pump())
+            return
+        if reply.get("fatal"):
+            # non-transient grant failure (e.g. runtime_env working_dir
+            # missing): retrying can never succeed
+            with self.lock:
+                self.pending_lease_requests -= 1
+            self._fail_all(RayError(reply["fatal"]))
             return
         if reply.get("pg_gone"):
             # Raylet no longer hosts any bundle of the PG (released or
@@ -2305,6 +2336,7 @@ class _LeasePool:
                 "lease_worker",
                 demand=self.demand,
                 lease_type="task",
+                runtime_env=self.runtime_env,
                 allow_spill=False,
             )
         except Exception:

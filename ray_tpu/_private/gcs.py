@@ -139,25 +139,46 @@ class GcsServer:
         if self._persist_path:
             self._dirty.set()
 
-    def _persist_now(self):
-        """Synchronous atomic snapshot write."""
+    def _snapshot_bytes(self) -> Optional[bytes]:
+        """Pickle the durable tables. Runs on the event loop so the
+        snapshot is a consistent point-in-time view (single-threaded
+        mutations); the heavy file write happens off-loop."""
         import pickle
 
         try:
-            data = pickle.dumps({
+            return pickle.dumps({
                 "actors": self._actors,
                 "named_actors": self._named_actors,
                 "pgs": self._pgs,
                 "jobs": self._jobs,
                 "kv": {ns: dict(t) for ns, t in self._kv.items()},
             })
+        except Exception as e:  # noqa: BLE001 — persistence must not
+            # take the control plane down; stale snapshots are logged
+            print(f"[gcs] snapshot pickle failed: {e}", flush=True)
+            return None
+
+    def _write_snapshot(self, data: bytes):
+        try:
             tmp = self._persist_path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(data)
             os.replace(tmp, self._persist_path)
-        except Exception as e:  # noqa: BLE001 — persistence must not
-            # take the control plane down; stale snapshots are logged
+        except Exception as e:  # noqa: BLE001
             print(f"[gcs] snapshot write failed: {e}", flush=True)
+
+    def _persist_now(self):
+        """Synchronous snapshot (shutdown path)."""
+        data = self._snapshot_bytes()
+        if data is not None:
+            self._write_snapshot(data)
+
+    async def _persist_async(self):
+        data = self._snapshot_bytes()
+        if data is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._write_snapshot, data
+            )
 
     async def _persist_loop(self):
         """Debounced atomic snapshots: coalesces bursts, loses at most
@@ -168,7 +189,7 @@ class GcsServer:
             await self._dirty.wait()
             await asyncio.sleep(0.05)
             self._dirty.clear()
-            self._persist_now()
+            await self._persist_async()
 
     async def _post_restore_reconcile(self):
         """After a restart: (a) idempotently re-push creations that were
@@ -203,13 +224,25 @@ class GcsServer:
         alive_nodes = {nid for nid, v in self._node_views.items()
                        if v.alive}
         for aid, rec in list(self._actors.items()):
-            if rec["state"] == ALIVE and \
-                    rec.get("node_id") not in alive_nodes:
+            if rec["state"] != ALIVE:
+                continue
+            if rec.get("node_id") not in alive_nodes:
                 self._on_actor_interrupted(
                     aid,
                     f"node {rec.get('node_id')} did not re-register "
                     f"after GCS restart",
                 )
+                continue
+            # the node came back, but did the actor's worker survive the
+            # outage? (its raylet's failure report may have been lost)
+            addr = rec.get("address")
+            if addr:
+                try:
+                    await self._pool.get(*addr).call("ping", timeout=5.0)
+                except Exception:
+                    self._on_actor_interrupted(
+                        aid, "actor worker unreachable after GCS restart"
+                    )
         for pgid, pg in self._pgs.items():
             placement = pg.get("placement") or []
             if pg["state"] == "CREATED" and any(
@@ -596,7 +629,7 @@ class GcsServer:
             # durable BEFORE the push: a GCS crash mid-creation must
             # restore the assigned worker so reconcile re-pushes to the
             # same process (idempotent) instead of double-creating
-            self._persist_now()
+            await self._persist_async()
         await self._finish_actor_creation(aid, rec, raylet, lease,
                                           worker_addr, node_id)
 

@@ -72,7 +72,7 @@ def detect_node_resources() -> Tuple[Dict[str, float], Dict[str, str]]:
 
 class _Lease:
     __slots__ = ("lease_id", "worker", "demand", "pg_key", "lease_type",
-                 "released")
+                 "released", "created")
 
     def __init__(self, lease_id, worker, demand, pg_key, lease_type):
         self.lease_id = lease_id
@@ -80,6 +80,7 @@ class _Lease:
         self.demand = demand
         self.pg_key = pg_key
         self.lease_type = lease_type
+        self.created = time.time()
         # True while the worker is blocked in ray.get and its resources
         # are temporarily returned (reference: blocked-task CPU release)
         self.released = False
@@ -87,10 +88,10 @@ class _Lease:
 
 class _WorkerHandle:
     __slots__ = ("worker_id", "proc", "address", "registered", "alive",
-                 "reserved", "tpu")
+                 "reserved", "tpu", "env_key", "idle_since")
 
     def __init__(self, worker_id: str, proc: subprocess.Popen,
-                 tpu: bool = False):
+                 tpu: bool = False, env_key=None):
         self.worker_id = worker_id
         self.proc = proc
         self.address: Optional[Tuple[str, int]] = None
@@ -100,6 +101,10 @@ class _WorkerHandle:
         # worker; register_worker must not put it in the idle pool.
         self.reserved = False
         self.tpu = tpu
+        # runtime-env pool key (None = vanilla worker); reference:
+        # worker_pool.h runtime-env-keyed pools
+        self.env_key = env_key
+        self.idle_since = 0.0
 
 
 class Raylet:
@@ -153,10 +158,12 @@ class Raylet:
         # via TPU_VISIBLE_CHIPS at _private/accelerators/tpu.py:32-41), so
         # only leases demanding TPU get workers with the TPU runtime
         # enabled; plain workers start ~2s faster and can't steal the chip.
-        self._idle_workers: Dict[bool, collections.deque] = {
-            False: collections.deque(),
-            True: collections.deque(),
-        }
+        # keyed (tpu, env_key): workers with a runtime env only serve
+        # leases with the same env (reference: worker_pool.h pools)
+        self._idle_workers: Dict[Tuple[bool, Optional[str]],
+                                 collections.deque] = (
+            collections.defaultdict(collections.deque)
+        )
         self._workers: Dict[str, _WorkerHandle] = {}
         self._leases: Dict[str, _Lease] = {}
         self._starting = 0
@@ -165,6 +172,10 @@ class Raylet:
         # "committed"}
         self._bundles: Dict[Tuple[str, int], dict] = {}
 
+        # worker deaths not yet acknowledged by the GCS
+        self._pending_failure_reports: collections.deque = (
+            collections.deque()
+        )
         # queued lease requests waiting for resources
         self._lease_waiters: collections.deque = collections.deque()
         self._lease_wakeup = asyncio.Event()
@@ -245,6 +256,10 @@ class Raylet:
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._lease_grant_loop()))
         self._bg.append(asyncio.ensure_future(self._worker_watcher_loop()))
+        if self._cfg.memory_usage_threshold > 0:
+            self._bg.append(
+                asyncio.ensure_future(self._memory_monitor_loop())
+            )
         n_prestart = self._cfg.prestart_workers
         for _ in range(n_prestart):
             self._spawn_worker()
@@ -312,7 +327,19 @@ class Raylet:
     # ------------------------------------------------------------------
     # worker pool (reference: src/ray/raylet/worker_pool.h:152)
     # ------------------------------------------------------------------
-    def _spawn_worker(self, tpu: bool = False) -> _WorkerHandle:
+    @staticmethod
+    def _runtime_env_key(runtime_env: Optional[dict]) -> Optional[str]:
+        if not runtime_env:
+            return None
+        import hashlib
+        import json as _json
+
+        return hashlib.sha1(
+            _json.dumps(runtime_env, sort_keys=True).encode()
+        ).hexdigest()[:12]
+
+    def _spawn_worker(self, tpu: bool = False,
+                      runtime_env: Optional[dict] = None) -> _WorkerHandle:
         worker_id = uuid.uuid4().hex
         log = open(
             os.path.join(self.session_dir, "logs", f"worker-{worker_id[:8]}.log"),
@@ -330,6 +357,16 @@ class Raylet:
             # jax at the backend we just disabled.
             env["PALLAS_AXON_POOL_IPS"] = ""
             env["JAX_PLATFORMS"] = "cpu"
+        # runtime env applied at spawn (reference: runtime_env_agent
+        # prepares the env before the worker starts, runtime_env_agent.py:165)
+        cwd = None
+        if runtime_env:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[k] = str(v)
+            wd = runtime_env.get("working_dir")
+            if wd:
+                cwd = wd
+                env["PYTHONPATH"] = wd + os.pathsep + env["PYTHONPATH"]
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -347,9 +384,11 @@ class Raylet:
             stdout=log,
             stderr=subprocess.STDOUT,
             env=env,
+            cwd=cwd,
         )
         log.close()
-        handle = _WorkerHandle(worker_id, proc, tpu=tpu)
+        handle = _WorkerHandle(worker_id, proc, tpu=tpu,
+                               env_key=self._runtime_env_key(runtime_env))
         self._workers[worker_id] = handle
         self._starting += 1
         return handle
@@ -363,12 +402,16 @@ class Raylet:
         handle.registered.set()
         self._starting = max(0, self._starting - 1)
         if not handle.reserved:
-            self._idle_workers[handle.tpu].append(worker_id)
+            handle.idle_since = time.time()
+            self._idle_workers[(handle.tpu, handle.env_key)].append(
+                worker_id)
         self._lease_wakeup.set()
         return True
 
-    async def _pop_worker(self, tpu: bool = False) -> Optional[_WorkerHandle]:
-        pool = self._idle_workers[tpu]
+    async def _pop_worker(self, tpu: bool = False,
+                          env_key: Optional[str] = None
+                          ) -> Optional[_WorkerHandle]:
+        pool = self._idle_workers[(tpu, env_key)]
         while pool:
             wid = pool.popleft()
             handle = self._workers.get(wid)
@@ -379,6 +422,32 @@ class Raylet:
     async def _worker_watcher_loop(self):
         while True:
             await asyncio.sleep(0.2)
+            # cull idle runtime-env workers: each distinct env is its own
+            # pool, so without a TTL every env leaks a resident process
+            ttl = self._cfg.runtime_env_worker_ttl_s
+            now = time.time()
+            for (tpu, env_key), pool in list(self._idle_workers.items()):
+                if env_key is None:
+                    continue
+                keep: collections.deque = collections.deque()
+                while pool:
+                    wid = pool.popleft()
+                    h = self._workers.get(wid)
+                    if h is None:
+                        continue
+                    if now - h.idle_since > ttl:
+                        h.alive = False
+                        try:
+                            h.proc.terminate()
+                        except Exception:
+                            pass
+                        self._workers.pop(wid, None)
+                    else:
+                        keep.append(wid)
+                if keep:
+                    self._idle_workers[(tpu, env_key)] = keep
+                else:
+                    self._idle_workers.pop((tpu, env_key), None)
             for wid, handle in list(self._workers.items()):
                 if handle.alive and handle.proc.poll() is not None:
                     handle.alive = False
@@ -390,17 +459,27 @@ class Raylet:
                             if not lease.released:
                                 self._release_lease_resources(lease)
                             self._leases.pop(lid, None)
-                    try:
-                        await self.gcs.aio.call(
-                            "report_worker_failure",
-                            node_id=self.node_id,
-                            worker_id=wid,
-                            reason=f"worker process exited with code "
-                            f"{handle.proc.returncode}",
-                        )
-                    except Exception:
-                        pass
+                    self._pending_failure_reports.append(
+                        (wid, f"worker process exited with code "
+                              f"{handle.proc.returncode}")
+                    )
                     self._lease_wakeup.set()
+            # deliver failure reports, retrying across GCS restarts —
+            # a swallowed one-shot report would leave the GCS believing
+            # an actor is ALIVE forever
+            while self._pending_failure_reports:
+                wid, reason = self._pending_failure_reports[0]
+                try:
+                    await self.gcs.aio.call(
+                        "report_worker_failure",
+                        node_id=self.node_id,
+                        worker_id=wid,
+                        reason=reason,
+                        timeout=5.0,
+                    )
+                    self._pending_failure_reports.popleft()
+                except Exception:
+                    break  # retry next tick
 
     # ------------------------------------------------------------------
     # leases (reference: NodeManager::HandleRequestWorkerLease
@@ -500,16 +579,26 @@ class Raylet:
             if granted is False:
                 return {"ok": False, "spill_to": None, "infeasible": False}
             resolved_key = granted  # the grant loop acquired + resolved
-        return await self._grant_lease(demand, resolved_key, lease_type)
+        return await self._grant_lease(demand, resolved_key, lease_type,
+                                       runtime_env)
 
-    async def _grant_lease(self, demand, pg_key, lease_type):
+    async def _grant_lease(self, demand, pg_key, lease_type,
+                           runtime_env: Optional[dict] = None):
         needs_tpu = any(
             k == "TPU" or k.startswith("TPU-") for k, v in demand.items()
             if v > 0
         )
-        worker = await self._pop_worker(needs_tpu)
+        env_key = self._runtime_env_key(runtime_env)
+        worker = await self._pop_worker(needs_tpu, env_key)
         if worker is None:
-            worker = self._spawn_worker(tpu=needs_tpu)
+            try:
+                worker = self._spawn_worker(tpu=needs_tpu,
+                                            runtime_env=runtime_env)
+            except Exception as e:  # e.g. bad runtime_env working_dir
+                self._release_after_grant(demand, pg_key)
+                return {"ok": False, "spill_to": None,
+                        "infeasible": False,
+                        "fatal": f"worker spawn failed: {e}"}
         worker.reserved = True
         try:
             await asyncio.wait_for(
@@ -580,7 +669,9 @@ class Raylet:
         handle = lease.worker
         if ok and handle.alive and handle.proc.poll() is None:
             handle.reserved = False
-            self._idle_workers[handle.tpu].append(handle.worker_id)
+            handle.idle_since = time.time()
+            self._idle_workers[(handle.tpu, handle.env_key)].append(
+                handle.worker_id)
         else:
             handle.alive = False
             try:
@@ -642,6 +733,79 @@ class Raylet:
                 else:
                     subtract(self.available, lease.demand)
         return True
+
+    # ------------------------------------------------------------------
+    # memory monitor (reference: common/memory_monitor.h:52 + the
+    # retriable-FIFO worker-killing policy, worker_killing_policy.h:39)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _node_memory_usage() -> float:
+        """Fraction of node memory in use. Test override: a file named by
+        RAY_TPU_TESTING_MEM_USAGE_FILE holding a float (mirrors the
+        reference's fake-memory test hooks, test_memory_pressure.py)."""
+        override = os.environ.get("RAY_TPU_TESTING_MEM_USAGE_FILE")
+        if override:
+            try:
+                with open(override) as f:
+                    return float(f.read().strip() or 0.0)
+            except (OSError, ValueError):
+                return 0.0
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = float(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = float(line.split()[1])
+            if total and avail is not None:
+                return 1.0 - avail / total
+        except OSError:
+            pass
+        return 0.0
+
+    async def _memory_monitor_loop(self):
+        threshold = self._cfg.memory_usage_threshold
+        while True:
+            await asyncio.sleep(self._cfg.memory_monitor_refresh_s)
+            usage = self._node_memory_usage()
+            if usage <= threshold:
+                continue
+            victim = self._pick_memory_victim()
+            if victim is None:
+                continue
+            print(
+                f"[raylet] memory usage {usage:.2f} > {threshold:.2f}: "
+                f"killing worker {victim.worker.worker_id[:8]} (newest "
+                f"retriable task lease) — the owner will retry",
+                flush=True,
+            )
+            handle = victim.worker
+            handle.alive = False
+            try:
+                handle.proc.kill()
+            except Exception:
+                pass
+            # lease/resource cleanup rides the worker watcher loop.
+            # Cooldown: wait for the victim to actually exit plus one
+            # refresh so reclaimed memory shows up before picking
+            # another victim (prevents kill cascades).
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, handle.proc.wait, 10.0
+                )
+            except Exception:
+                pass
+            await asyncio.sleep(self._cfg.memory_monitor_refresh_s)
+
+    def _pick_memory_victim(self) -> Optional[_Lease]:
+        """Newest task lease (retriable-FIFO: tasks retry by default;
+        actors are never chosen — killing one loses state)."""
+        tasks = [l for l in self._leases.values()
+                 if l.lease_type == "task" and l.worker.alive]
+        if not tasks:
+            return None
+        return max(tasks, key=lambda l: l.created)
 
     async def kill_worker(self, worker_id: str):
         handle = self._workers.get(worker_id)

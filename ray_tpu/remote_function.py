@@ -112,6 +112,7 @@ class RemoteFunction:
             serialized_func=self._pickled,
             func_refs=self._pickled_refs,
             tensor_transport=o.get("tensor_transport"),
+            runtime_env=o.get("runtime_env"),
         )
         if num_returns == 1:
             return refs[0]

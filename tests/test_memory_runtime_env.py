@@ -1,0 +1,123 @@
+"""Memory monitor + runtime env tests.
+
+VERDICT item 10 'done' bar: an OOM test that survives (monitor kills the
+newest retriable task instead of the node dying) and a worker that sees
+runtime_env env_vars/working_dir. Reference: memory_monitor.h:52,
+worker_killing_policy.h:39, runtime_env_agent.py:165.
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture()
+def mem_cluster(tmp_path):
+    usage_file = tmp_path / "mem_usage"
+    usage_file.write_text("0.1")
+    os.environ["RAY_TPU_TESTING_MEM_USAGE_FILE"] = str(usage_file)
+    os.environ["RAY_TPU_MEMORY_MONITOR_REFRESH_S"] = "0.2"
+    ray.init(resources={"CPU": 4, "memory": 10**9})
+    yield usage_file
+    ray.shutdown()
+    os.environ.pop("RAY_TPU_TESTING_MEM_USAGE_FILE", None)
+    os.environ.pop("RAY_TPU_MEMORY_MONITOR_REFRESH_S", None)
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 4, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+def test_memory_pressure_kills_and_retries_task(mem_cluster, tmp_path):
+    usage_file = mem_cluster
+    attempts = tmp_path / "attempts"
+
+    @ray.remote(max_retries=3)
+    def fat_task():
+        # count attempts across retries via the filesystem
+        with open(attempts, "a") as f:
+            f.write("x")
+        n = len(open(attempts).read())
+        if n == 1:
+            time.sleep(30)  # first attempt lingers under pressure
+        return n
+
+    ref = fat_task.remote()
+    time.sleep(1.0)  # let attempt 1 start
+    usage_file.write_text("0.99")  # node under memory pressure
+    time.sleep(1.5)  # monitor kills the newest task lease
+    usage_file.write_text("0.1")  # pressure gone
+
+    # the task was killed and retried; the retry returns fast
+    assert ray.get(ref, timeout=60) == 2
+    # the cluster survived — new work still runs
+    @ray.remote
+    def ok():
+        return "fine"
+
+    assert ray.get(ok.remote(), timeout=30) == "fine"
+
+
+def test_runtime_env_env_vars_task(ray_start):
+    @ray.remote(runtime_env={"env_vars": {"MY_FLAG": "hello42"}})
+    def read_env():
+        return os.environ.get("MY_FLAG")
+
+    @ray.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray.get(read_env.remote(), timeout=60) == "hello42"
+    assert ray.get(read_plain.remote(), timeout=60) is None
+
+
+def test_runtime_env_working_dir(ray_start, tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "mymod_rt_env.py").write_text("VALUE = 'from-working-dir'\n")
+    (wd / "data.txt").write_text("payload")
+
+    @ray.remote(runtime_env={"working_dir": str(wd)})
+    def use_wd():
+        import mymod_rt_env  # importable from working_dir
+
+        return mymod_rt_env.VALUE, open("data.txt").read(), os.getcwd()
+
+    val, data, cwd = ray.get(use_wd.remote(), timeout=60)
+    assert val == "from-working-dir"
+    assert data == "payload"
+    assert os.path.realpath(cwd) == os.path.realpath(str(wd))
+
+
+def test_runtime_env_actor(ray_start):
+    @ray.remote
+    class EnvActor:
+        def flag(self):
+            return os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"ACTOR_FLAG": "actor-env"}}
+    ).remote()
+    assert ray.get(a.flag.remote(), timeout=60) == "actor-env"
+
+
+def test_runtime_env_workers_not_shared(ray_start):
+    """A vanilla task must never land on a runtime-env worker."""
+    @ray.remote(runtime_env={"env_vars": {"POLLUTED": "yes"}})
+    def polluted():
+        return os.getpid()
+
+    @ray.remote
+    def vanilla():
+        return os.environ.get("POLLUTED"), os.getpid()
+
+    ppid = ray.get(polluted.remote(), timeout=60)
+    for _ in range(4):
+        flag, vpid = ray.get(vanilla.remote(), timeout=60)
+        assert flag is None
+        assert vpid != ppid
