@@ -9,3 +9,8 @@ compiles, and OpenAI-style serving through ray_tpu.serve.
 from .engine import EngineConfig, GenerationResult, LLMEngine, SamplingParams  # noqa: F401
 from .serving import LLMServer, build_openai_app  # noqa: F401
 from .batch import batch_generate  # noqa: F401
+from .disagg import (  # noqa: F401
+    DecodeReplica,
+    DisaggregatedLLM,
+    PrefillReplica,
+)

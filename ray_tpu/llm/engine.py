@@ -36,6 +36,21 @@ class EngineConfig:
     max_batch_size: int = 8
     max_seq_len: int = 1024
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024)
+    # KV layout: "slab" = fixed [B, S] slot cache; "paged" = paged KV
+    # (ops/paged_attention.py) — memory scales with actual sequence
+    # lengths, admission reserves only the pages a request can use.
+    kv_layout: str = "slab"
+    page_size: int = 32
+    num_pages: int = 0  # 0 = max_batch_size * max_seq_len / page_size
+
+    def effective_prefill_buckets(self) -> tuple:
+        """Paged layouts admit only page-aligned buckets; prefill
+        replicas must agree with decode engines on this."""
+        if self.kv_layout != "paged":
+            return self.prefill_buckets
+        return tuple(
+            b for b in self.prefill_buckets if b % self.page_size == 0
+        ) or (self.max_seq_len,)
 
 
 @dataclass
@@ -50,9 +65,9 @@ class GenerationResult:
 
 class _Request:
     __slots__ = ("rid", "prompt", "params", "generated", "event", "result",
-                 "submit_time", "first_token_time")
+                 "submit_time", "first_token_time", "prefilled")
 
-    def __init__(self, rid, prompt, params):
+    def __init__(self, rid, prompt, params, prefilled=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.params = params
@@ -61,6 +76,10 @@ class _Request:
         self.result: Optional[GenerationResult] = None
         self.submit_time = time.time()
         self.first_token_time: Optional[float] = None
+        # (kv {k,v: [L,1,bucket,Hkv,D]}, first_token) from a prefill
+        # replica — decode-side admission skips the prefill compute
+        # (prefill/decode disaggregation, llm/disagg.py)
+        self.prefilled = prefilled
 
 
 class LLMEngine:
@@ -88,22 +107,55 @@ class LLMEngine:
             else init_params(model_config, jax.random.PRNGKey(seed))
         )
         B, S = self.ecfg.max_batch_size, self.ecfg.max_seq_len
-        self.cache = init_cache(model_config, B, S)
         self.lengths = np.zeros(B, dtype=np.int32)
         self.slots: List[Optional[_Request]] = [None] * B
         self._rng = np.random.default_rng(seed)
 
         cfg = model_config
+        self.paged = self.ecfg.kv_layout == "paged"
+        if self.paged:
+            from ..models.llama import (
+                forward_paged_decode,
+                init_paged_cache,
+                write_prompt_to_pages,
+            )
 
-        # compile once: batched single-token decode
+            ps = self.ecfg.page_size
+            if S % ps:
+                raise ValueError(f"max_seq_len {S} not a multiple of "
+                                 f"page_size {ps}")
+            self.ecfg.prefill_buckets = self.ecfg.effective_prefill_buckets()
+            # page 0 is sacrificial scratch: inactive slots' page-table
+            # rows are zero, so their masked-out decode writes land there
+            # instead of corrupting a live page
+            self.num_pages = self.ecfg.num_pages or (B * S // ps + 1)
+            self.pages = init_paged_cache(cfg, self.num_pages, ps)
+            self.free_pages: List[int] = list(range(1, self.num_pages))
+            self.page_tables = np.zeros((B, S // ps), dtype=np.int32)
+            self._slot_pages: List[List[int]] = [[] for _ in range(B)]
+
+            def paged_step(params, pages, tokens, page_tables, lengths):
+                logits, pages = forward_paged_decode(
+                    cfg, params, tokens, pages, page_tables, lengths
+                )
+                return logits, pages
+
+            self._decode_paged = jax.jit(paged_step, donate_argnums=(1,))
+            self._write_pages = jax.jit(write_prompt_to_pages,
+                                        donate_argnums=(0,))
+        else:
+            self.cache = init_cache(model_config, B, S)
+
+        # compile once: batched single-token decode (slab layout)
         def decode_step(params, cache, tokens, lengths):
             logits, cache = forward_cached(cfg, params, tokens, cache,
                                            lengths)
             return logits[:, -1, :], cache
 
-        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        if not self.paged:
+            self._decode = jax.jit(decode_step, donate_argnums=(1,))
 
-        # prefill per bucket, single slot
+        # prefill per bucket, single slot (both layouts)
         def prefill(params, cache1, tokens, true_len):
             zero = jnp.zeros((1,), dtype=jnp.int32)
             logits, cache1 = forward_cached(cfg, params, tokens, cache1,
@@ -114,6 +166,10 @@ class LLMEngine:
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        # head-of-line request whose page reservation is pending: retried
+        # before the queue so big requests aren't starved by later small
+        # ones grabbing freed pages
+        self._parked: Optional[_Request] = None
         self._next_rid = 0
         self._rid_lock = threading.Lock()
         self._stop = threading.Event()
@@ -134,6 +190,23 @@ class LLMEngine:
                 f"prompt length {len(req.prompt)} >= max_seq_len "
                 f"{self.ecfg.max_seq_len}"
             )
+        self._queue.put(req)
+        return req
+
+    def generate_prefilled_async(
+        self,
+        prompt_tokens: List[int],
+        kv: Dict[str, Any],  # {k, v: [L, 1, bucket, Hkv, D]}
+        first_token: int,
+        params: Optional[SamplingParams] = None,
+    ) -> _Request:
+        """Admit a request whose prefill ran on another replica
+        (prefill/decode disaggregation)."""
+        with self._rid_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = _Request(rid, prompt_tokens, params or SamplingParams(),
+                       prefilled=(kv, first_token))
         self._queue.put(req)
         return req
 
@@ -162,11 +235,16 @@ class LLMEngine:
         self._thread.join(timeout=5)
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "active": sum(s is not None for s in self.slots),
             "waiting": self._queue.qsize(),
             "max_batch": self.ecfg.max_batch_size,
+            "kv_layout": self.ecfg.kv_layout,
         }
+        if self.paged:
+            out["free_pages"] = len(self.free_pages)
+            out["total_pages"] = self.num_pages - 1  # minus scratch
+        return out
 
     # ------------------------------------------------------------------
     # scheduler loop
@@ -190,13 +268,26 @@ class LLMEngine:
                     if req is not None:
                         self._finish_with_error(i, err)
                 # decode/prefill donate the cache buffer (donate_argnums):
-                # an exception after donation leaves self.cache permanently
-                # invalid, which would fail every future request. Rebuild it.
-                from ..models.llama import init_cache
+                # an exception after donation leaves the cache permanently
+                # invalid, which would fail every future request. Rebuild.
+                if self.paged:
+                    from ..models.llama import init_paged_cache
 
-                self.cache = init_cache(
-                    self.cfg, self.ecfg.max_batch_size, self.ecfg.max_seq_len
-                )
+                    self.pages = init_paged_cache(
+                        self.cfg, self.num_pages, self.ecfg.page_size
+                    )
+                    self.free_pages = list(range(1, self.num_pages))
+                    self._slot_pages = [
+                        [] for _ in range(self.ecfg.max_batch_size)
+                    ]
+                    self.page_tables[:] = 0
+                else:
+                    from ..models.llama import init_cache
+
+                    self.cache = init_cache(
+                        self.cfg, self.ecfg.max_batch_size,
+                        self.ecfg.max_seq_len,
+                    )
                 self.lengths[:] = 0
                 self.slots = [None] * self.ecfg.max_batch_size
                 time.sleep(0.05)
@@ -212,6 +303,7 @@ class LLMEngine:
         )
         self.slots[i] = None
         self.lengths[i] = 0
+        self._free_slot_pages(i)
         req.event.set()
 
     def _loop_once(self, jnp):
@@ -230,12 +322,21 @@ class LLMEngine:
                 last_tokens[i, 0] = (
                     req.generated[-1] if req.generated else req.prompt[-1]
                 )
-            logits, self.cache = self._decode(
-                self.params,
-                self.cache,
-                jnp.asarray(last_tokens),
-                jnp.asarray(self.lengths),
-            )
+            if self.paged:
+                logits, self.pages = self._decode_paged(
+                    self.params,
+                    self.pages,
+                    jnp.asarray(last_tokens),
+                    jnp.asarray(self.page_tables),
+                    jnp.asarray(self.lengths),
+                )
+            else:
+                logits, self.cache = self._decode(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(last_tokens),
+                    jnp.asarray(self.lengths),
+                )
             logits_np = np.asarray(logits)
             self.lengths[active] += 1
             now = time.time()
@@ -253,11 +354,66 @@ class LLMEngine:
         for i in range(self.ecfg.max_batch_size):
             if self.slots[i] is not None:
                 continue
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
+            if self._parked is not None:
+                req, self._parked = self._parked, None
+            else:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
             bucket = self._bucket(len(req.prompt))
+            if self.paged and not self._reserve_pages(i, req, bucket):
+                ps = self.ecfg.page_size
+                horizon = min(
+                    len(req.prompt) + req.params.max_tokens + 1,
+                    self.ecfg.max_seq_len,
+                )
+                need = max(bucket // ps, -(-horizon // ps))
+                if need > self.num_pages - 1:
+                    # can never fit: fail fast instead of spinning
+                    req.result = GenerationResult(
+                        request_id=req.rid,
+                        prompt_tokens=req.prompt,
+                        token_ids=[],
+                        finish_reason=(
+                            f"error: request needs {need} KV pages but "
+                            f"the engine has {self.num_pages - 1}"
+                        ),
+                        latency_s=time.time() - req.submit_time,
+                    )
+                    req.event.set()
+                    continue
+                # wait head-of-line until pages free up
+                self._parked = req
+                break
+            if req.prefilled is not None:
+                # disaggregated admission: KV arrived from a prefill
+                # replica (device transport); install it and skip the
+                # prefill compute entirely
+                kv, first_tok = req.prefilled
+                req.prefilled = None  # free the transferred copy
+                kvb = kv["k"].shape[2]
+                if self.paged:
+                    ps = self.ecfg.page_size
+                    rows = jnp.asarray(
+                        self._slot_pages[i][: kvb // ps],
+                        dtype=jnp.int32,
+                    )
+                    self.pages = self._write_pages(self.pages, kv, rows)
+                else:
+                    self.cache = {
+                        "k": self.cache["k"].at[:, i, :kvb].set(
+                            kv["k"][:, 0]),
+                        "v": self.cache["v"].at[:, i, :kvb].set(
+                            kv["v"][:, 0]),
+                    }
+                self.lengths[i] = len(req.prompt)
+                req.generated.append(int(first_tok))
+                req.first_token_time = req.first_token_time or time.time()
+                self.slots[i] = req
+                admitted = True
+                self._maybe_finish(i)
+                continue
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, : len(req.prompt)] = req.prompt
             from ..models.llama import init_cache
@@ -267,11 +423,22 @@ class LLMEngine:
                 self.params, cache1, jnp.asarray(tokens),
                 np.int32(len(req.prompt)),
             )
-            # scatter the prefilled row into the shared cache at slot i
-            self.cache = {
-                "k": self.cache["k"].at[:, i].set(cache1["k"][:, 0]),
-                "v": self.cache["v"].at[:, i].set(cache1["v"][:, 0]),
-            }
+            if self.paged:
+                ps = self.ecfg.page_size
+                nb = bucket // ps
+                rows = jnp.asarray(self._slot_pages[i][:nb],
+                                   dtype=jnp.int32)
+                sliced = {
+                    "k": cache1["k"][:, :, :bucket],
+                    "v": cache1["v"][:, :, :bucket],
+                }
+                self.pages = self._write_pages(self.pages, sliced, rows)
+            else:
+                # scatter the prefilled row into the shared cache, slot i
+                self.cache = {
+                    "k": self.cache["k"].at[:, i].set(cache1["k"][:, 0]),
+                    "v": self.cache["v"].at[:, i].set(cache1["v"][:, 0]),
+                }
             self.lengths[i] = len(req.prompt)
             tok = self._sample(np.asarray(last_logits), req.params)
             req.generated.append(int(tok))
@@ -280,6 +447,28 @@ class LLMEngine:
             admitted = True
             self._maybe_finish(i)
         return admitted
+
+    def _reserve_pages(self, i: int, req: "_Request", bucket: int) -> bool:
+        """Allocate exactly the pages this request can ever touch:
+        max(prefill bucket, prompt+max_tokens+1) rounded to pages."""
+        ps = self.ecfg.page_size
+        horizon = min(len(req.prompt) + req.params.max_tokens + 1,
+                      self.ecfg.max_seq_len)
+        need = max(bucket // ps, -(-horizon // ps))
+        if len(self.free_pages) < need:
+            return False
+        pages = [self.free_pages.pop() for _ in range(need)]
+        self._slot_pages[i] = pages
+        row = np.zeros(self.page_tables.shape[1], dtype=np.int32)
+        row[: len(pages)] = pages
+        self.page_tables[i] = row
+        return True
+
+    def _free_slot_pages(self, i: int):
+        if self.paged:
+            self.free_pages.extend(self._slot_pages[i])
+            self._slot_pages[i] = []
+            self.page_tables[i] = 0
 
     def _sample(self, logits: np.ndarray, params: SamplingParams) -> int:
         if params.temperature <= 0.0:
@@ -315,4 +504,5 @@ class LLMEngine:
         )
         self.slots[i] = None
         self.lengths[i] = 0
+        self._free_slot_pages(i)
         req.event.set()

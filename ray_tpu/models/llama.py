@@ -412,6 +412,113 @@ def forward_cached(
     return logits, {"k": new_k, "v": new_v}
 
 
+# ---------------------------------------------------------------------------
+# Paged KV-cache path (serving; reference capability: vLLM PagedAttention,
+# consumed as a black box by ray.llm — here native, ops/paged_attention.py)
+# ---------------------------------------------------------------------------
+def init_paged_cache(cfg: LlamaConfig, num_pages: int, page_size: int
+                     ) -> Dict[str, jax.Array]:
+    # head-major layout [L, Hkv, P, ps, D]: every Pallas block spans the
+    # full trailing (page_size, head_dim) tile (ops/paged_attention.py)
+    shape = (cfg.n_layers, cfg.n_kv_heads, num_pages, page_size,
+             cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=cfg.dtype),
+        "v": jnp.zeros(shape, dtype=cfg.dtype),
+    }
+
+
+def forward_paged_decode(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    tokens: jax.Array,      # [B, 1] next token per sequence
+    pages: Dict[str, jax.Array],
+    page_table: jax.Array,  # [B, n_pages_per_seq] int32
+    lengths: jax.Array,     # [B] current filled KV length per sequence
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One decode step over paged KV: writes the new token's K/V into
+    each sequence's current page, attends over the page table. Returns
+    (logits [B, vocab], updated pages)."""
+    from ..ops.paged_attention import paged_attention
+
+    B = tokens.shape[0]
+    hd = cfg.head_dim
+    ps = pages["k"].shape[3]
+    x = params["tok_embed"][tokens]  # [B, 1, d]
+    positions = lengths[:, None]  # [B, 1]
+    batch_ix = jnp.arange(B)
+    page_ix = page_table[batch_ix, lengths // ps]  # [B] physical page
+    offset = lengths % ps
+
+    def layer(x, scanned):
+        lp, k_pages_l, v_pages_l = scanned
+        h = _rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, 1, cfg.n_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, 1, cfg.n_kv_heads, hd)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        # pages [Hkv, P, ps, D]: scatter the new token's KV per batch row
+        knew = k[:, 0].transpose(1, 0, 2)  # [Hkv, B, D]
+        vnew = v[:, 0].transpose(1, 0, 2)
+        k_pages_l = k_pages_l.at[:, page_ix, offset].set(
+            knew.astype(k_pages_l.dtype))
+        v_pages_l = v_pages_l.at[:, page_ix, offset].set(
+            vnew.astype(v_pages_l.dtype))
+        attn = paged_attention(
+            q, k_pages_l, v_pages_l, page_table, lengths + 1
+        )
+        x = x + attn.reshape(B, 1, cfg.n_heads * hd) @ lp["wo"]
+        h = _rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        if cfg.n_experts > 0:
+            from .moe import moe_ffn
+
+            y, _ = moe_ffn(
+                h.reshape(B, cfg.dim), lp["router"], lp["we1"],
+                lp["we3"], lp["we2"], cfg.n_experts_per_tok,
+                cfg.capacity_factor,
+            )
+            x = x + y.reshape(B, 1, cfg.dim)
+        else:
+            gate = jax.nn.silu(
+                (h @ lp["w1"]).astype(jnp.float32)
+            ).astype(x.dtype)
+            x = x + (gate * (h @ lp["w3"])) @ lp["w2"]
+        return x, (k_pages_l, v_pages_l)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], pages["k"], pages["v"])
+    )
+    x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (
+        params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
+    )
+    logits = (x[:, 0].astype(jnp.float32) @ head.astype(jnp.float32))
+    return logits, {"k": new_k, "v": new_v}
+
+
+def write_prompt_to_pages(
+    pages: Dict[str, jax.Array],
+    prefill_cache: Dict[str, jax.Array],  # [L, 1, S_bucket, Hkv, D]
+    page_rows: jax.Array,  # [S_bucket // page_size] physical pages
+) -> Dict[str, jax.Array]:
+    """Scatter a dense bucketed-prefill KV row into this sequence's
+    pages (rows past the true prompt length are garbage but masked by
+    `lengths` at attention time)."""
+    L, _, S, Hkv, D = prefill_cache["k"].shape
+    ps = pages["k"].shape[3]
+    nb = S // ps
+    # [L, S, Hkv, D] -> [L, Hkv, nb, ps, D] (head-major page layout)
+    k_rows = prefill_cache["k"][:, 0].reshape(
+        L, nb, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    v_rows = prefill_cache["v"][:, 0].reshape(
+        L, nb, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    return {
+        "k": pages["k"].at[:, :, page_rows].set(k_rows),
+        "v": pages["v"].at[:, :, page_rows].set(v_rows),
+    }
+
+
 def loss_fn(
     cfg: LlamaConfig,
     params: Dict[str, Any],

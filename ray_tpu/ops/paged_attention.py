@@ -1,0 +1,176 @@
+"""Paged KV-cache attention: decode-time attention over paged KV.
+
+Reference capability: vLLM's PagedAttention, which ray.llm consumes as a
+black box (llm/_internal/serve/deployments/llm/vllm/). Rebuilt TPU-first:
+KV lives in fixed-size pages laid out [Hkv, num_pages, page_size, D] —
+head-major so every Pallas block spans the full trailing (page_size, D)
+tile (TPU lowering requires the last two block dims to match the array
+or its native tiling). Each sequence owns a page table of physical page
+indices; the kernel uses Pallas scalar prefetch so the grid's page
+dimension is *indirected through the page table* — each
+(batch, head, page) step DMAs the right physical page into VMEM and
+accumulates online softmax in scratch, the same shape as
+ops/attention.py's flash kernel.
+
+The portable path (CPU tests / small shapes) gathers pages with jnp
+indexing and masks by sequence length — numerically identical.
+
+Decode only (one query token per sequence): prefill writes pages via
+dense bucketed attention (models/llama.py write_prompt_to_pages).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# portable path
+# ---------------------------------------------------------------------------
+def _paged_attention_jnp(q, k_pages, v_pages, page_table, lengths, scale):
+    B, H, D = q.shape[0], q.shape[2], q.shape[3]
+    n_pages, ps = page_table.shape[1], k_pages.shape[2]
+    Hkv = k_pages.shape[0]
+    S = n_pages * ps
+    # gather: [Hkv, B, n_pages, ps, D] -> [B, S, Hkv, D]
+    k = k_pages[:, page_table].reshape(Hkv, B, S, D).transpose(1, 2, 0, 3)
+    v = v_pages[:, page_table].reshape(Hkv, B, S, D).transpose(1, 2, 0, 3)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale  # [B, H, 1, S]
+    mask = jnp.arange(S)[None, None, None, :] < lengths[:, None, None, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, page_size: int, scale: float):
+    """Grid: (B, H, n_pages) — pages innermost, scratch accumulates."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    np_ = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+
+    @pl.when(i * page_size < length)
+    def _compute():
+        # all VMEM stores stay 2D (Mosaic: no scalar stores)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)  # [1, D]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)  # [ps, D]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [1, ps]
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1
+        )
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_prev = m_scr[0, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)  # [1, ps]
+        l_scr[:, :] = l_scr[:, :] * alpha + jnp.sum(
+            p, axis=1, keepdims=True
+        )
+        acc_scr[:, :] = acc_scr[:, :] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [1, D]
+        m_scr[:, :] = jnp.full((1, 1), m_cur, dtype=jnp.float32)
+
+    @pl.when(i == np_ - 1)
+    def _finalize():
+        l = l_scr[0, 0]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[:, :] / denom).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                            scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, T, H, D = q.shape
+    n_pages = page_table.shape[1]
+    Hkv, _, ps, _ = k_pages.shape
+    rep = H // Hkv
+    # [B, 1, H, D] -> [B, H, 1, D]: trailing block dims (1, D) match the
+    # array, satisfying the TPU tiling rule
+    qt = q.transpose(0, 2, 1, 3)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # page_table, lengths
+        grid=(B, H, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, D),
+                         lambda b, h, i, pt, ln: (b, h, 0, 0)),
+            # physical page selected through the prefetched page table
+            pl.BlockSpec((1, 1, ps, D),
+                         lambda b, h, i, pt, ln: (h // rep, pt[b, i],
+                                                  0, 0)),
+            pl.BlockSpec((1, 1, ps, D),
+                         lambda b, h, i, pt, ln: (h // rep, pt[b, i],
+                                                  0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, D),
+                               lambda b, h, i, pt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, page_size=ps, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(page_table, lengths, qt, k_pages, v_pages)
+    return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
+
+
+def paged_attention(
+    q: jax.Array,           # [B, 1, H, D] — one decode token per seq
+    k_pages: jax.Array,     # [Hkv, num_pages, page_size, D]
+    v_pages: jax.Array,
+    page_table: jax.Array,  # [B, n_pages_per_seq] int32 physical pages
+    lengths: jax.Array,     # [B] int32 valid KV length
+    scale: Optional[float] = None,
+) -> jax.Array:
+    D = q.shape[-1]
+    scale = (D ** -0.5) if scale is None else scale
+    if jax.default_backend() == "tpu" and q.shape[1] == 1:
+        try:
+            return _paged_attention_pallas(
+                q, k_pages, v_pages, page_table, lengths, scale
+            )
+        except Exception:
+            pass  # fall through to the portable path
+    return _paged_attention_jnp(
+        q, k_pages, v_pages, page_table, lengths, scale
+    )

@@ -1,0 +1,132 @@
+"""Serve benchmark: req/s + TTFT through the full serve data plane.
+
+The BASELINE.json north-star names "Ray Serve req/s + p50 TTFT" as a
+headline serving metric; the reference ships no in-repo numbers (fresh
+TPU measurements required — BASELINE.md §serving). This harness measures
+the native stack end-to-end: HTTP proxy -> router -> replica ->
+continuous-batching engine (paged KV), and writes BENCH_serve.json.
+
+Run: python scripts/bench_serve.py [--requests 64] [--concurrency 16]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--output", default="BENCH_serve.json")
+    args = ap.parse_args()
+
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu as ray
+    from ray_tpu import serve
+
+    ray.init(resources={"CPU": 8, "memory": 4 * 10**9})
+    from ray_tpu.llm.serving import LLMServer
+
+    Dep = serve.deployment(LLMServer, num_replicas=1,
+                           ray_actor_options={"num_cpus": 2})
+    http_port = 8971
+    serve.run(Dep.bind(
+        model_config={"preset": "tiny", "dim": 256, "n_layers": 4,
+                      "n_heads": 8, "n_kv_heads": 4, "vocab_size": 512,
+                      "max_seq_len": 512},
+        engine_config={"max_batch_size": 8, "max_seq_len": 512,
+                       "kv_layout": "paged", "page_size": 32},
+    ), name="llm", route_prefix="/llm", http_port=http_port)
+    url = f"http://127.0.0.1:{http_port}/llm"
+
+    rng = np.random.default_rng(0)
+    prompt = [int(x) for x in rng.integers(1, 500, args.prompt_len)]
+    payload = json.dumps({
+        "prompt": prompt, "max_tokens": args.max_tokens,
+    }).encode()
+
+    # warm (compiles prefill + decode)
+    urllib.request.urlopen(
+        urllib.request.Request(url, data=payload,
+                               headers={"Content-Type":
+                                        "application/json"}),
+        timeout=600,
+    ).read()
+
+    results = []
+    lock = threading.Lock()
+    sem = threading.Semaphore(args.concurrency)
+    errors = []
+
+    def one(i):
+        with sem:
+            t0 = time.perf_counter()
+            try:
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(
+                        url, data=payload,
+                        headers={"Content-Type": "application/json"}),
+                    timeout=600,
+                ).read()
+                body = json.loads(resp)
+                wall = time.perf_counter() - t0
+                ttft = body.get("metrics", {}).get("ttft_s", wall)
+                ntok = body.get("usage", {}).get("completion_tokens", 0)
+                with lock:
+                    results.append((wall, ttft, ntok))
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(str(e))
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(args.requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+
+    walls = sorted(r[0] for r in results)
+    ttfts = sorted(r[1] for r in results)
+    toks = sum(r[2] for r in results)
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else None
+
+    out = {
+        "requests": len(results),
+        "errors": len(errors),
+        "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "req_per_s": round(len(results) / elapsed, 2),
+        "decode_tok_per_s": round(toks / elapsed, 1),
+        "p50_latency_s": round(pct(walls, 0.50), 4),
+        "p95_latency_s": round(pct(walls, 0.95), 4),
+        "p50_ttft_s": round(pct(ttfts, 0.50), 4),
+        "p95_ttft_s": round(pct(ttfts, 0.95), 4),
+        "backend": __import__("jax").default_backend(),
+    }
+    print(json.dumps(out))
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=2)
+    serve.shutdown()
+    ray.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Paged KV attention + paged engine + prefill/decode disaggregation.
+
+VERDICT item 6: paged/ragged KV-cache attention, prefill/decode
+disaggregation across two replica pools. Reference: vLLM PagedAttention
+(black-box to ray.llm) + prefill_decode_disagg/.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.llm import (
+    DisaggregatedLLM,
+    EngineConfig,
+    LLMEngine,
+    SamplingParams,
+)
+from ray_tpu.models import LlamaConfig
+from ray_tpu.ops.paged_attention import paged_attention
+
+
+# ---------------------------------------------------------------------------
+# kernel correctness
+# ---------------------------------------------------------------------------
+def test_paged_attention_matches_dense():
+    """Paged attention over a shuffled page table == dense attention over
+    the logically contiguous KV."""
+    B, H, Hkv, D, ps, n_pages = 3, 8, 4, 64, 16, 4
+    S = n_pages * ps
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(keys[0], (B, 1, H, D))
+    k_dense = jax.random.normal(keys[1], (B, S, Hkv, D))
+    v_dense = jax.random.normal(keys[2], (B, S, Hkv, D))
+    lengths = jnp.asarray([S, 37, 1], dtype=jnp.int32)
+
+    # scatter dense KV into shuffled physical pages (head-major layout)
+    total_pages = B * n_pages + 1
+    perm = np.random.default_rng(0).permutation(
+        np.arange(1, total_pages))
+    page_table = perm.reshape(B, n_pages).astype(np.int32)
+    k_pages = jnp.zeros((Hkv, total_pages, ps, D))
+    v_pages = jnp.zeros((Hkv, total_pages, ps, D))
+    for b in range(B):
+        for p in range(n_pages):
+            k_rows = k_dense[b, p * ps:(p + 1) * ps].transpose(1, 0, 2)
+            v_rows = v_dense[b, p * ps:(p + 1) * ps].transpose(1, 0, 2)
+            k_pages = k_pages.at[:, page_table[b, p]].set(k_rows)
+            v_pages = v_pages.at[:, page_table[b, p]].set(v_rows)
+
+    got = paged_attention(q, k_pages, v_pages,
+                          jnp.asarray(page_table), lengths)
+
+    # dense reference with per-sequence length masking
+    from ray_tpu.ops.attention import _attention_jnp
+
+    for b in range(B):
+        L = int(lengths[b])
+        want = _attention_jnp(
+            q[b:b + 1], k_dense[b:b + 1, :L], v_dense[b:b + 1, :L],
+            causal=False, scale=D ** -0.5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[b]), np.asarray(want[0]),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+# ---------------------------------------------------------------------------
+# paged engine
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return LlamaConfig.tiny(n_layers=2, dim=64, n_heads=4, n_kv_heads=2,
+                            vocab_size=128, max_seq_len=256)
+
+
+def test_paged_engine_matches_slab(tiny_cfg):
+    """Greedy generation must be identical between KV layouts."""
+    prompts = [[5, 9, 3], [17, 2, 8, 11, 4], list(range(1, 40))]
+    sp = SamplingParams(max_tokens=8)
+
+    slab = LLMEngine(tiny_cfg, engine_config=EngineConfig(
+        max_batch_size=4, max_seq_len=128, kv_layout="slab"), seed=0)
+    slab_out = [r.token_ids for r in slab.generate_batch(prompts, sp)]
+    slab.shutdown()
+
+    paged = LLMEngine(tiny_cfg, engine_config=EngineConfig(
+        max_batch_size=4, max_seq_len=128, kv_layout="paged",
+        page_size=32), seed=0)
+    paged_out = [r.token_ids for r in paged.generate_batch(prompts, sp)]
+    st = paged.stats()
+    paged.shutdown()
+
+    assert paged_out == slab_out
+    assert st["kv_layout"] == "paged"
+    assert st["free_pages"] == st["total_pages"]  # all freed at the end
+
+
+def test_paged_engine_page_accounting(tiny_cfg):
+    eng = LLMEngine(tiny_cfg, engine_config=EngineConfig(
+        max_batch_size=2, max_seq_len=128, kv_layout="paged",
+        page_size=32, num_pages=9), seed=0)  # 8 usable + scratch
+    sp = SamplingParams(max_tokens=4)
+    # each request: bucket 32 -> 1-2 pages; all complete even when
+    # admission has to wait for pages
+    out = eng.generate_batch([[1, 2, 3]] * 6, sp, timeout=120)
+    assert all(len(r.token_ids) == 4 for r in out)
+    assert eng.stats()["free_pages"] == 8
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# prefill/decode disaggregation
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 8, "memory": 2 * 10**9})
+    yield
+    ray.shutdown()
+
+
+def test_disagg_matches_single_engine(ray_start, tiny_cfg):
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=128)
+    sp = SamplingParams(max_tokens=6)
+    prompts = [[3, 1, 4, 1, 5], [9, 2, 6]]
+
+    ref_eng = LLMEngine(tiny_cfg, engine_config=ecfg, seed=0)
+    want = [r.token_ids for r in ref_eng.generate_batch(prompts, sp)]
+    ref_eng.shutdown()
+
+    llm = DisaggregatedLLM(tiny_cfg, ecfg, num_prefill=1, num_decode=1,
+                           seed=0)
+    try:
+        got = [llm.generate(p, sp, timeout=180).token_ids
+               for p in prompts]
+    finally:
+        llm.shutdown()
+    assert got == want
+
+
+def test_disagg_concurrent_requests(ray_start, tiny_cfg):
+    ecfg = EngineConfig(max_batch_size=4, max_seq_len=128)
+    sp = SamplingParams(max_tokens=5)
+    llm = DisaggregatedLLM(tiny_cfg, ecfg, num_prefill=2, num_decode=2,
+                           seed=0)
+    try:
+        refs = [llm.generate_async([i + 1, i + 2, i + 3], sp)
+                for i in range(8)]
+        results = ray.get(refs, timeout=300)
+    finally:
+        llm.shutdown()
+    assert len(results) == 8
+    for r in results:
+        assert len(r.token_ids) == 5
+        assert r.finish_reason == "length"
