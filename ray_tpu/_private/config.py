@@ -61,6 +61,9 @@ class Config:
     lease_timeout_s: float = 30.0
     # Max workers to keep pre-started per node (0 = num_cpus).
     prestart_workers: int = 0
+    # Tasks per push RPC to a leased worker (amortizes per-call RPC and
+    # event-loop overhead for bursts of small tasks; 1 = unbatched).
+    task_push_batch: int = 16
     worker_register_timeout_s: float = 30.0
 
     # --- fault tolerance ---

@@ -466,8 +466,12 @@ class CoreWorker:
         s.register_method("add_borrowers", self._rpc_add_borrowers)
         s.register_method("remove_borrowers", self._rpc_remove_borrowers)
         s.register_method("push_task", self._rpc_push_task)
+        s.register_method("push_tasks", self._rpc_push_tasks)
+        s.register_method("report_tasks_done",
+                          self._rpc_report_tasks_done)
         s.register_method("push_actor_creation", self._rpc_push_actor_creation)
         s.register_method("push_actor_task", self._rpc_push_actor_task)
+        s.register_method("push_actor_tasks", self._rpc_push_actor_tasks)
         s.register_method("exit_worker", self._rpc_exit_worker)
         s.register_method("cancel_task", self._rpc_cancel_task)
         s.register_method("ping", self._rpc_ping)
@@ -1183,11 +1187,15 @@ class CoreWorker:
             return pool
 
     def _on_task_done(self, spec: dict, returns: List[tuple], node_id: str):
-        """Submitter callback with the executor's reply."""
+        """Submitter callback with the executor's reply. Idempotent: a
+        streamed per-task completion (report_task_done) and the batch
+        reply may both carry the same result."""
         task_id = spec["task_id"]
         with self._records_lock:
             task = self._tasks.get(task_id)
             if task is not None:
+                if task.status in ("FINISHED", "FAILED"):
+                    return
                 task.status = "FINISHED"
         if task is not None:
             retained, task.retained = task.retained, []
@@ -1220,9 +1228,13 @@ class CoreWorker:
 
     def _on_task_failed(self, spec: dict, error: Exception) -> bool:
         """Returns True if the task will be retried."""
+        task_id = spec["task_id"]
+        with self._records_lock:
+            done = self._tasks.get(task_id)
+            if done is not None and done.status == "FINISHED":
+                return False  # result already streamed before the failure
         self._count("ray_tpu_tasks_failed_total",
                     "task attempts that failed")
-        task_id = spec["task_id"]
         with self._records_lock:
             task = self._tasks.get(task_id)
             if task is not None and task.retries_left > 0:
@@ -1425,6 +1437,85 @@ class CoreWorker:
             self._task_executor, self._execute_task, spec
         )
 
+    def _task_error_reply(self, spec: dict, e: Exception) -> dict:
+        tb = traceback.format_exc()
+        err = serialization.dumps(
+            RayTaskError(f"{type(e).__name__}: {e}\n{tb}",
+                         type(e).__name__)
+        )
+        task_id = TaskID(spec["task_id"])
+        return {
+            "returns": [
+                (ObjectID.for_task_return(task_id, i).binary(), "err",
+                 err)
+                for i in range(spec["num_returns"])
+            ],
+            "node_id": self.node_id,
+        }
+
+    async def _rpc_push_tasks(self, specs: List[dict]):
+        """Batched push: one RPC, but execution stays SEQUENTIAL — the
+        lease this batch rides carries one task's resources, so running
+        items concurrently would oversubscribe the node. Each completion
+        streams back to the owner immediately (report_task_done), so a
+        fast task's caller never waits on a slow batchmate; the batch
+        reply doubles as an idempotent fallback."""
+        loop = asyncio.get_running_loop()
+        results = []
+        # completed-but-unstreamed results flush on a 5ms timer: a fast
+        # task's caller must not block on a slow batchmate, but sub-ms
+        # batches shouldn't pay one RPC per item either. The timer fires
+        # on the loop even while the next task runs in the executor.
+        reporter = _BatchReporter(self, loop)
+
+        def run_one(spec):
+            # an exception escaping _execute_task (e.g. _pack_returns
+            # ValueError) must fail only ITS task, never the batchmates
+            try:
+                return self._execute_task(spec)
+            except Exception as e:  # noqa: BLE001
+                return self._task_error_reply(spec, e)
+
+        for spec in specs:
+            res = await loop.run_in_executor(
+                self._task_executor, run_one, spec
+            )
+            results.append(res)
+            reporter.add(spec["task_id"], res["returns"],
+                         spec["owner_address"])
+        reporter.close()  # unflushed tail rides the reply
+        return {"results": results, "node_id": self.node_id}
+
+    def _flush_task_reports(self, items: List[tuple]):
+        by_owner: Dict[tuple, list] = {}
+        for task_id, returns, owner_addr in items:
+            by_owner.setdefault(tuple(owner_addr), []).append(
+                (task_id, returns))
+
+        async def send(addr, batch):
+            # best-effort: the batch reply is the authoritative fallback,
+            # and a dead owner must not spam unhandled-task errors
+            try:
+                await self._pool.get(*addr).call(
+                    "report_tasks_done", items=batch,
+                    node_id=self.node_id,
+                )
+            except Exception:
+                pass
+
+        for addr, batch in by_owner.items():
+            asyncio.ensure_future(send(addr, batch))
+
+    async def _rpc_report_tasks_done(self, items: List[tuple],
+                                     node_id: str):
+        """Owner-side: streamed completions of batched tasks."""
+        for task_id, returns in items:
+            with self._records_lock:
+                task = self._tasks.get(task_id)
+            if task is not None:
+                self._on_task_done(task.spec, returns, node_id)
+        return True
+
     def _execute_task(self, spec: dict):
         try:
             func = self._load_function(spec)
@@ -1563,6 +1654,35 @@ class CoreWorker:
             q.draining = True
             asyncio.ensure_future(self._drain_caller_queue(q))
         return await fut
+
+    async def _rpc_push_actor_tasks(self, specs: List[dict],
+                                    seqs: List[int], caller: str,
+                                    abandoned: tuple = ()):
+        """Batched ordered actor push: items feed the same per-caller
+        seq queue as individual pushes; replies return in order. Early
+        completions stream to the owner (a caller get()ing the first
+        ref must not wait for the whole batch), and one item's failure
+        never discards its batchmates' results."""
+        loop = asyncio.get_running_loop()
+        reporter = _BatchReporter(self, loop)
+
+        async def run_one(i, spec, seq):
+            try:
+                res = await self._rpc_push_actor_task(
+                    spec, seq, caller, abandoned if i == 0 else ()
+                )
+            except Exception as e:  # noqa: BLE001
+                res = self._actor_error_reply(spec, e)
+            reporter.add(spec["task_id"], res["returns"],
+                         spec["owner_address"])
+            return res
+
+        results = await asyncio.gather(*[
+            run_one(i, spec, seq)
+            for i, (spec, seq) in enumerate(zip(specs, seqs))
+        ])
+        reporter.close()
+        return {"results": results}
 
     async def _drain_caller_queue(self, q: "_CallerQueue"):
         try:
@@ -2085,6 +2205,40 @@ class CoreWorker:
 # Lease pool: one per scheduling class (reference: NormalTaskSubmitter's
 # per-SchedulingKey lease management, normal_task_submitter.h:79)
 # ---------------------------------------------------------------------------
+class _BatchReporter:
+    """Streams completed-but-unreplied batch results to their owners on
+    a 5ms timer; results still pending when the batch reply goes out are
+    dropped (the reply delivers them, _on_task_done is idempotent)."""
+
+    def __init__(self, worker, loop):
+        self.worker = worker
+        self.loop = loop
+        self.pending: list = []
+        self.armed = False
+
+    def add(self, task_id, returns, owner_address):
+        self.pending.append((task_id, returns, owner_address))
+        if not self.armed:
+            self.armed = True
+            self.loop.call_later(0.005, self.flush)
+
+    def flush(self):
+        self.armed = False
+        if self.pending:
+            self.worker._flush_task_reports(self.pending)
+            self.pending = []
+
+    def close(self):
+        self.pending = []
+
+
+def _spec_has_refs(spec: dict) -> bool:
+    """True if any task arg is an ObjectRef (packed as ("ref", ...))."""
+    return any(a[0] == "ref" for a in spec["args"]) or any(
+        v[0] == "ref" for v in spec["kwargs"].values()
+    )
+
+
 class _LeasePool:
     MAX_LEASES_PER_CLASS = int(os.environ.get("RAY_TPU_MAX_LEASES", "64"))
 
@@ -2126,17 +2280,44 @@ class _LeasePool:
                     return
                 if self.free_leases:
                     lease = self.free_leases.popleft()
-                    spec = self.queue.popleft()
-                else:
-                    if (
-                        self.num_leases + self.pending_lease_requests
-                        < min(len(self.queue), self.MAX_LEASES_PER_CLASS)
-                        or self.num_leases + self.pending_lease_requests == 0
+                    # batch: one RPC round-trip carries many small tasks
+                    # (reference gets this from C++ pipelining; here it
+                    # amortizes the event-loop + socket cost per task).
+                    # Only plain DEFAULT pools batch (SPREAD places per
+                    # task; PG/affinity pools must spread over bundles),
+                    # and only REF-FREE tasks: a batch replies once at
+                    # the end, so an in-batch task whose arg is another
+                    # in-batch task's result would deadlock waiting for
+                    # a reply that cannot be sent yet.
+                    batch = 1
+                    if self.strategy == "DEFAULT" and not self.params:
+                        batch = max(1, self.worker._cfg.task_push_batch)
+                        # leave work for the other free leases: batching
+                        # must never serialize what could run in parallel
+                        fair = -(-len(self.queue) //
+                                 (len(self.free_leases) + 1))
+                        batch = min(batch, max(1, fair))
+                    specs = [self.queue.popleft()]
+                    while (
+                        len(specs) < batch and self.queue
+                        and not _spec_has_refs(specs[-1])
+                        and not _spec_has_refs(self.queue[0])
                     ):
+                        specs.append(self.queue.popleft())
+                else:
+                    # no free lease: grow while pending requests don't
+                    # cover the queue — leases busy with long-running
+                    # tasks must not starve newly queued work (mirrors
+                    # the reference's per-task RequestWorkerLease)
+                    if (
+                        self.pending_lease_requests < len(self.queue)
+                        and self.num_leases + self.pending_lease_requests
+                        < self.MAX_LEASES_PER_CLASS
+                    ) or self.num_leases + self.pending_lease_requests == 0:
                         self.pending_lease_requests += 1
                         asyncio.ensure_future(self._request_lease())
                     return
-            asyncio.ensure_future(self._dispatch(lease, spec))
+            asyncio.ensure_future(self._dispatch(lease, specs))
 
     async def _resolve_pg_node(self, pg_id: str) -> Optional[str]:
         """Pick the node owning this request's target bundle; waits for the
@@ -2358,32 +2539,36 @@ class _LeasePool:
                 self.queue.extend(retry)
             EventLoopThread.get().spawn(self._pump())
 
-    async def _dispatch(self, lease: dict, spec: dict):
+    async def _dispatch(self, lease: dict, specs: List[dict]):
         w = self.worker
         addr = lease["worker_address"]
         cli = w._pool.get(addr[0], int(addr[1]))
         try:
             # Non-idempotent: a mid-call connection drop must not replay the
             # push (the worker may have executed it); _on_task_failed below
-            # applies the task's own max_retries policy instead.
-            reply = await cli.call("push_task", spec=spec, idempotent=False)
+            # applies each task's own max_retries policy instead.
+            reply = await cli.call("push_tasks", specs=specs,
+                                   idempotent=False)
         except RpcNotDeliveredError:
             # The push never reached the worker (it died before connect):
             # resubmit without consuming max_retries — nothing executed.
             with self.lock:
                 self.num_leases -= 1
             await self._return_lease(lease, ok=False)
-            self.enqueue(spec)
+            for spec in specs:
+                self.enqueue(spec)
             return
         except (RpcConnectionError, RpcApplicationError) as e:
             with self.lock:
                 self.num_leases -= 1
             await self._return_lease(lease, ok=False)
-            if w._on_task_failed(spec, e):
-                self.enqueue(spec)
+            for spec in specs:
+                if w._on_task_failed(spec, e):
+                    self.enqueue(spec)
             asyncio.ensure_future(self._pump())
             return
-        w._on_task_done(spec, reply["returns"], reply["node_id"])
+        for spec, res in zip(specs, reply["results"]):
+            w._on_task_done(spec, res["returns"], reply["node_id"])
         with self.lock:
             # SPREAD leases are single-use: reuse would pin the whole burst
             # to whichever node answered first (reference: spread policy
@@ -2472,8 +2657,9 @@ class _ActorSubmitter:
                     spec["_seq"] = self.seq
                     spec["_inc"] = self.incarnation
                     self.seq += 1
-        for spec in specs:
-            asyncio.ensure_future(self._send(spec))
+        batch = max(1, self.worker._cfg.task_push_batch)
+        for i in range(0, len(specs), batch):
+            asyncio.ensure_future(self._send_batch(specs[i:i + batch]))
 
     def _adopt_address(self, new_addr: tuple, restarts: Optional[int] = None):
         """Adopt a (re)resolved actor address; caller holds self.lock.
@@ -2564,6 +2750,73 @@ class _ActorSubmitter:
             retained, task.retained = task.retained, []
             for oid in retained:
                 w._release_ref(oid)
+
+    async def _send_batch(self, specs: List[dict]):
+        """One RPC carries a run of consecutive actor calls (same caller,
+        consecutive seqs) — the actor-side ordered queue slots each item
+        exactly as if pushed individually, but the event-loop and socket
+        cost is paid once per batch."""
+        if len(specs) == 1:
+            await self._send(specs[0])
+            return
+        w = self.worker
+        addr = self.address
+        if addr is None:
+            with self.lock:
+                self.queue.extend(specs)
+            await self._pump()
+            return
+        cli = w._pool.get(*addr)
+        sent_abandoned = sorted(self._abandoned)
+        try:
+            reply = await cli.call(
+                "push_actor_tasks",
+                specs=[{k: v for k, v in sp.items()
+                        if not k.startswith("_")} for sp in specs],
+                seqs=[sp["_seq"] for sp in specs],
+                caller=w.worker_id,
+                abandoned=sent_abandoned,
+                idempotent=False,
+            )
+        except RpcApplicationError as e:
+            err = serialization.dumps(
+                RayTaskError(str(e), "RpcApplicationError"))
+            for sp in specs:
+                self._fail_spec(sp, err)
+            return
+        except RpcNotDeliveredError:
+            with self.lock:
+                self.queue.extend(specs)
+                self.address = None
+                self.state = "PENDING"
+            await asyncio.sleep(0.2)
+            await self._pump()
+            return
+        except (RpcConnectionError, Exception) as e:
+            requeued = False
+            with self.lock:
+                self.address = None
+                self.state = "PENDING"
+                for sp in specs:
+                    if sp.get("_retries", 0) > 0:
+                        sp["_retries"] -= 1
+                        self.queue.append(sp)
+                        requeued = True
+                    else:
+                        if sp.get("_inc") == self.incarnation:
+                            self._abandoned.add(sp["_seq"])
+                        self._fail_spec(sp, serialization.dumps(
+                            RayActorError(
+                                f"actor task failed: "
+                                f"{type(e).__name__}: {e}"
+                            )
+                        ))
+            if requeued:
+                await self._pump()
+            return
+        self._abandoned.difference_update(sent_abandoned)
+        for sp, res in zip(specs, reply["results"]):
+            w._on_task_done(sp, res["returns"], res["node_id"])
 
     async def _send(self, spec: dict):
         w = self.worker
