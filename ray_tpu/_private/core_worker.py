@@ -1453,6 +1453,9 @@ class CoreWorker:
             "node_id": self.node_id,
         }
 
+    # actor-task execution packs errors identically
+    _actor_error_reply = _task_error_reply
+
     async def _rpc_push_tasks(self, specs: List[dict]):
         """Batched push: one RPC, but execution stays SEQUENTIAL — the
         lease this batch rides carries one task's resources, so running
@@ -1784,19 +1787,6 @@ class CoreWorker:
             "node_id": self.node_id,
         }
 
-    def _actor_error_reply(self, spec, e: Exception):
-        tb = traceback.format_exc()
-        err = serialization.dumps(
-            RayTaskError(f"{type(e).__name__}: {e}\n{tb}", type(e).__name__)
-        )
-        task_id = TaskID(spec["task_id"])
-        return {
-            "returns": [
-                (ObjectID.for_task_return(task_id, i).binary(), "err", err)
-                for i in range(spec["num_returns"])
-            ],
-            "node_id": self.node_id,
-        }
 
     async def _rpc_exit_worker(self, reason: str = ""):
         def _die():
@@ -2292,10 +2282,13 @@ class _LeasePool:
                     batch = 1
                     if self.strategy == "DEFAULT" and not self.params:
                         batch = max(1, self.worker._cfg.task_push_batch)
-                        # leave work for the other free leases: batching
-                        # must never serialize what could run in parallel
+                        # leave work for the other free leases AND the
+                        # leases already requested but not yet granted:
+                        # batching must never serialize what could run
+                        # in parallel
                         fair = -(-len(self.queue) //
-                                 (len(self.free_leases) + 1))
+                                 (len(self.free_leases)
+                                  + self.pending_lease_requests + 1))
                         batch = min(batch, max(1, fair))
                     specs = [self.queue.popleft()]
                     while (
@@ -2658,8 +2651,24 @@ class _ActorSubmitter:
                     spec["_inc"] = self.incarnation
                     self.seq += 1
         batch = max(1, self.worker._cfg.task_push_batch)
-        for i in range(0, len(specs), batch):
-            asyncio.ensure_future(self._send_batch(specs[i:i + batch]))
+        # Chunk into batches, but never extend a batch across a
+        # ref-bearing spec: a batch replies once at the end, so a later
+        # in-batch task whose arg is an earlier in-batch result would
+        # depend on the best-effort completion stream alone — if that one
+        # RPC is lost, the arg fetch blocks and the batch deadlocks (the
+        # normal-task pump applies the same exclusion).
+        run: List[dict] = []
+        for sp in specs:
+            if run and (
+                len(run) >= batch
+                or _spec_has_refs(run[-1])
+                or _spec_has_refs(sp)
+            ):
+                asyncio.ensure_future(self._send_batch(run))
+                run = []
+            run.append(sp)
+        if run:
+            asyncio.ensure_future(self._send_batch(run))
 
     def _adopt_address(self, new_addr: tuple, restarts: Optional[int] = None):
         """Adopt a (re)resolved actor address; caller holds self.lock.
@@ -2735,6 +2744,11 @@ class _ActorSubmitter:
         w = self.worker
         task_id = TaskID(spec["task_id"])
         with w._records_lock:
+            done = w._tasks.get(spec["task_id"])
+            if done is not None and done.status == "FINISHED":
+                # Result already streamed via report_tasks_done before
+                # the batch transport failed — the call succeeded.
+                return
             for i in range(spec["num_returns"]):
                 oid = ObjectID.for_task_return(task_id, i)
                 rec = w._records.get(oid.binary())
@@ -2798,6 +2812,11 @@ class _ActorSubmitter:
                 self.address = None
                 self.state = "PENDING"
                 for sp in specs:
+                    rec = w._tasks.get(sp["task_id"])
+                    if rec is not None and rec.status == "FINISHED":
+                        # executed + streamed before the drop: neither a
+                        # retry (duplicate side effects) nor a failure
+                        continue
                     if sp.get("_retries", 0) > 0:
                         sp["_retries"] -= 1
                         self.queue.append(sp)

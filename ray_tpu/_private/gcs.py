@@ -61,6 +61,8 @@ class GcsServer:
         self._nodes: Dict[str, dict] = {}
         self._node_views: Dict[str, NodeView] = {}
         self._last_heartbeat: Dict[str, float] = {}
+        self._node_idle: Dict[str, float] = {}
+        self._node_demand: Dict[str, List[Dict[str, float]]] = {}
 
         # kv: namespace -> key -> bytes
         self._kv: Dict[str, Dict[str, bytes]] = collections.defaultdict(dict)
@@ -397,17 +399,55 @@ class GcsServer:
         node_id: str,
         available: Dict[str, float],
         idle_duration_s: float = 0.0,
+        pending_demand: Optional[List[Dict[str, float]]] = None,
     ):
         """Resource report; reply carries the full cluster view (syncer)."""
         v = self._node_views.get(node_id)
         if v is None:
             return None  # unknown node: tells raylet to re-register
         self._last_heartbeat[node_id] = time.time()
+        self._node_idle[node_id] = idle_duration_s
+        self._node_demand[node_id] = pending_demand or []
         old_avail = v.available
         v.available = dict(available)
         if old_avail != v.available:
             self._kick_schedulers()
         return self._cluster_view()
+
+    async def get_autoscaler_state(self):
+        """Aggregate demand + idle view for the autoscaler (reference:
+        GcsAutoscalerStateManager, src/ray/gcs/gcs_server/
+        gcs_autoscaler_state_manager.cc; autoscaler.proto)."""
+        pending: List[Dict[str, float]] = []
+        for shapes in self._node_demand.values():
+            pending.extend(shapes)
+        # Actors the GCS scheduler couldn't place yet.
+        for aid in list(self._pending_actors):
+            rec = self._actors.get(aid)
+            if rec is not None and rec.get("demand"):
+                pending.append(rec["demand"])
+        pending_pg_bundles: List[List[Dict[str, float]]] = []
+        for pgid in list(self._pending_pgs):
+            pg = self._pgs.get(pgid)
+            if pg is not None:
+                pending_pg_bundles.append(
+                    [dict(b) for b in pg.get("bundles", [])]
+                )
+        return {
+            "nodes": {
+                nid: {
+                    "total": v.total,
+                    "available": v.available,
+                    "labels": v.labels,
+                    "alive": v.alive,
+                    "idle_duration_s": self._node_idle.get(nid, 0.0),
+                    "address": v.address,
+                }
+                for nid, v in self._node_views.items()
+            },
+            "pending_demand": pending,
+            "pending_pg_bundles": pending_pg_bundles,
+        }
 
     def _cluster_view(self):
         return {
@@ -441,6 +481,9 @@ class GcsServer:
             return
         v.alive = False
         v.available = {}
+        # a dead node's last demand report must not drive scale-up forever
+        self._node_demand.pop(node_id, None)
+        self._node_idle.pop(node_id, None)
         self._publish("NODE", {"event": "removed", "node_id": node_id, "reason": reason})
         # Actors on the dead node die (and maybe restart).
         for aid, rec in list(self._actors.items()):

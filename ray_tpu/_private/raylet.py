@@ -179,6 +179,11 @@ class Raylet:
         # queued lease requests waiting for resources
         self._lease_waiters: collections.deque = collections.deque()
         self._lease_wakeup = asyncio.Event()
+        # autoscaler feed: when this node last became fully idle (all
+        # resources free, nothing queued). 0.0 = currently busy.
+        self._node_idle_since: float = time.time()
+        # recently-seen infeasible shapes (shape-tuple -> last ts)
+        self._infeasible_demand: Dict[tuple, float] = {}
 
         # per-worker metric snapshots (reference: metrics_agent.py —
         # every process exports to the node agent; here the raylet IS
@@ -290,6 +295,34 @@ class Raylet:
     # ------------------------------------------------------------------
     # heartbeats / cluster view
     # ------------------------------------------------------------------
+    def _pending_demand_report(self) -> List[Dict[str, float]]:
+        """Queued lease shapes + infeasible shapes seen in the last few
+        seconds (infeasible requests retry from the submitter, so a
+        recent sighting means the demand is still live)."""
+        out = [dict(d) for d, _pg, _f in self._lease_waiters]
+        cutoff = time.time() - 5.0
+        for shape, ts in list(self._infeasible_demand.items()):
+            if ts < cutoff:
+                del self._infeasible_demand[shape]
+            else:
+                out.append(dict(shape))
+        return out
+
+    def _idle_duration_s(self) -> float:
+        """Seconds this node has been fully idle (autoscaler scale-down
+        signal; reference: autoscaler v2 reads per-node idle from the GCS
+        resource report)."""
+        busy = (
+            self.available != self.total
+            or bool(self._lease_waiters)
+        )
+        if busy:
+            self._node_idle_since = 0.0
+            return 0.0
+        if self._node_idle_since == 0.0:
+            self._node_idle_since = time.time()
+        return time.time() - self._node_idle_since
+
     async def _heartbeat_loop(self):
         period = self._cfg.health_check_period_s
         while True:
@@ -298,6 +331,8 @@ class Raylet:
                     "heartbeat",
                     node_id=self.node_id,
                     available=self.available,
+                    idle_duration_s=self._idle_duration_s(),
+                    pending_demand=self._pending_demand_report(),
                 )
                 if view is None:
                     # GCS restarted and lost us: re-register.
@@ -561,6 +596,14 @@ class Raylet:
         if pg_key is None and not resources_fit(self.total, demand):
             # Never fits here; suggest somewhere it could.
             spill = self._pick_spill_node(demand)
+            if spill is None:
+                # Cluster-infeasible right now: remember the shape so the
+                # heartbeat advertises it to the autoscaler (reference:
+                # infeasible demand in the GCS resource report feeds
+                # v2/scheduler.py bin-packing).
+                self._infeasible_demand[
+                    tuple(sorted(demand.items()))
+                ] = time.time()
             return {"ok": False, "spill_to": spill, "infeasible": spill is None}
 
         ok, resolved_key = self._try_acquire(demand, pg_key)

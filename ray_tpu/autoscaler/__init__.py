@@ -1,0 +1,28 @@
+"""Autoscaler: demand-driven cluster scaling.
+
+Reference: python/ray/autoscaler/v2/autoscaler.py:47 (Autoscaler),
+v2/scheduler.py:638 (ResourceDemandScheduler bin-packing),
+v2/instance_manager/reconciler.py (instance state machine),
+autoscaler/node_provider.py:13 (NodeProvider plugin ABC).
+
+TPU-native reframing: node types are *slices* — a node type carries the
+resources and labels of one TPU host (or slice gang); the scheduler
+bin-packs pending task/actor shapes and PG bundles onto hypothetical
+nodes of each type, launches what's needed via the NodeProvider, and
+terminates nodes idle past the timeout.
+"""
+from .config import AutoscalingConfig, NodeTypeConfig
+from .node_provider import NodeProvider
+from .fake_provider import FakeNodeProvider
+from .scheduler import ResourceDemandScheduler
+from .autoscaler import Autoscaler, StandardAutoscaler
+
+__all__ = [
+    "AutoscalingConfig",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "FakeNodeProvider",
+    "ResourceDemandScheduler",
+    "Autoscaler",
+    "StandardAutoscaler",
+]

@@ -1,0 +1,125 @@
+"""The autoscaler reconcile loop.
+
+Reference: python/ray/autoscaler/v2/autoscaler.py:47 (Autoscaler,
+update_autoscaling_state :169) + instance_manager/reconciler.py. One
+iteration: read the GCS autoscaler state (pending demand + per-node
+idle), diff against the provider's fleet, launch what the bin-packer
+asks for, terminate idle nodes.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .config import AutoscalingConfig
+from .node_provider import NodeProvider
+from .scheduler import ResourceDemandScheduler
+
+logger = logging.getLogger(__name__)
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        config: AutoscalingConfig,
+        provider: NodeProvider,
+        gcs_client,
+    ):
+        self.config = config
+        self.provider = provider
+        self.gcs = gcs_client
+        self.scheduler = ResourceDemandScheduler(config)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # pending-launch grace: provider_id -> launch ts (a created node
+        # that never registers is abandoned after this long)
+        self.launch_grace_s = 120.0
+        self._launch_ts: Dict[str, float] = {}
+
+    # -- one reconcile step (unit-testable without a loop) -------------
+    def update(self) -> Tuple[Dict[str, int], list]:
+        state = self.gcs.get_autoscaler_state()
+        fleet = self.provider.non_terminated_nodes()
+        gcs_nodes = state["nodes"]
+
+        counts: Dict[str, int] = {}
+        existing_avail = []
+        node_idle: Dict[str, Tuple[str, float]] = {}
+        now = time.time()
+        for pid, rec in fleet.items():
+            ntype = rec["node_type"]
+            counts[ntype] = counts.get(ntype, 0) + 1
+            nid = rec.get("node_id")
+            info = gcs_nodes.get(nid) if nid else None
+            if info is not None and info["alive"]:
+                existing_avail.append(dict(info["available"]))
+                node_idle[pid] = (ntype, info["idle_duration_s"])
+                self._launch_ts.pop(pid, None)
+            elif info is not None and not info["alive"]:
+                # dead in GCS: reclaim the instance
+                self.provider.terminate_node(pid)
+                counts[ntype] -= 1
+            else:
+                # still booting: counts toward capacity with its full
+                # node-type resources so we don't double-launch
+                nt = self.config.node_types.get(ntype)
+                if nt is not None:
+                    existing_avail.append(nt.copy_resources())
+                ts = self._launch_ts.setdefault(pid, now)
+                if now - ts > self.launch_grace_s:
+                    logger.warning("abandoning node %s (never joined)", pid)
+                    self.provider.terminate_node(pid)
+                    self._launch_ts.pop(pid, None)
+                    counts[ntype] -= 1
+
+        to_launch = self.scheduler.get_nodes_to_launch(
+            state["pending_demand"],
+            state["pending_pg_bundles"],
+            existing_avail,
+            counts,
+        )
+        for ntype, n in to_launch.items():
+            for pid in self.provider.create_node(ntype, n):
+                self._launch_ts[pid] = now
+
+        to_kill = []
+        if not to_launch and not state["pending_demand"]:
+            to_kill = self.scheduler.get_nodes_to_terminate(
+                node_idle, counts
+            )
+            for pid in to_kill:
+                nid = fleet[pid].get("node_id")
+                if nid:
+                    try:  # let running leases finish rejecting new work
+                        self.gcs.drain_node(node_id=nid)
+                    except Exception:
+                        pass
+                self.provider.terminate_node(pid)
+        return to_launch, to_kill
+
+    # -- background loop ----------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            self._stop.wait(self.config.update_interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# v1-compatible alias (reference: _private/autoscaler.py:172
+# StandardAutoscaler — same loop, config-file driven)
+StandardAutoscaler = Autoscaler

@@ -1,0 +1,51 @@
+"""Autoscaling configuration schema.
+
+Reference: the cluster-launcher YAML's ``available_node_types`` section
+(python/ray/autoscaler/_private/util.py validates it) and
+v2/instance_manager/config.py (NodeTypeConfig).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class NodeTypeConfig:
+    """One launchable node shape (e.g. one TPU-host flavor)."""
+
+    name: str
+    resources: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    min_workers: int = 0
+    max_workers: int = 10
+
+    def copy_resources(self) -> Dict[str, float]:
+        return dict(self.resources)
+
+
+@dataclass
+class AutoscalingConfig:
+    node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
+    max_workers: int = 64          # cluster-wide cap (excluding head)
+    idle_timeout_s: float = 60.0   # terminate nodes idle this long
+    update_interval_s: float = 1.0
+
+    @staticmethod
+    def from_dict(d: dict) -> "AutoscalingConfig":
+        node_types = {
+            name: NodeTypeConfig(
+                name=name,
+                resources=nt.get("resources", {}),
+                labels=nt.get("labels", {}),
+                min_workers=nt.get("min_workers", 0),
+                max_workers=nt.get("max_workers", 10),
+            )
+            for name, nt in d.get("available_node_types", {}).items()
+        }
+        return AutoscalingConfig(
+            node_types=node_types,
+            max_workers=d.get("max_workers", 64),
+            idle_timeout_s=d.get("idle_timeout_s", 60.0),
+            update_interval_s=d.get("update_interval_s", 1.0),
+        )

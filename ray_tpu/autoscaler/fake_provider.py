@@ -1,0 +1,90 @@
+"""Fake node provider: in-process "cloud" for tests and dev.
+
+Reference: python/ray/autoscaler/_private/fake_multi_node/node_provider.py
+— the provider behind nearly every autoscaler test in the reference CI
+(test_autoscaler_fake_multinode.py). Here each launched node is a real
+in-process raylet (ray_tpu Node) joined to the head's GCS, the same
+mechanism cluster_utils.Cluster uses for multi-node simulation.
+"""
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from .config import AutoscalingConfig
+from .node_provider import NodeProvider
+
+
+class FakeNodeProvider(NodeProvider):
+    def __init__(
+        self,
+        config: AutoscalingConfig,
+        gcs_address,
+        session_dir: Optional[str] = None,
+        launch_delay_s: float = 0.0,
+    ):
+        self.config = config
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.launch_delay_s = launch_delay_s
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, dict] = {}  # provider_id -> record
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        nt = self.config.node_types[node_type]
+        ids = []
+        for _ in range(count):
+            pid = f"fake-{node_type}-{uuid.uuid4().hex[:8]}"
+            with self._lock:
+                self._nodes[pid] = {"node_type": node_type, "node": None,
+                                    "node_id": None}
+            t = threading.Thread(
+                target=self._boot, args=(pid, nt), daemon=True
+            )
+            t.start()
+            ids.append(pid)
+        return ids
+
+    def _boot(self, pid: str, nt):
+        import time
+
+        from .._private.node import Node
+
+        if self.launch_delay_s:
+            time.sleep(self.launch_delay_s)
+        node = Node(
+            head=False,
+            gcs_address=self.gcs_address,
+            resources=dict(nt.resources),
+            labels={**nt.labels, "node-type": nt.name},
+            session_dir=self.session_dir,
+        )
+        with self._lock:
+            rec = self._nodes.get(pid)
+            if rec is None:  # terminated while booting
+                node.shutdown()
+                return
+            rec["node"] = node
+            rec["node_id"] = node.node_id
+
+    def terminate_node(self, provider_id: str) -> None:
+        with self._lock:
+            rec = self._nodes.pop(provider_id, None)
+        if rec and rec.get("node") is not None:
+            rec["node"].shutdown()
+
+    def non_terminated_nodes(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                pid: {"node_type": r["node_type"], "node_id": r["node_id"]}
+                for pid, r in self._nodes.items()
+            }
+
+    def shutdown(self) -> None:
+        with self._lock:
+            recs = list(self._nodes.values())
+            self._nodes.clear()
+        for r in recs:
+            if r.get("node") is not None:
+                r["node"].shutdown()

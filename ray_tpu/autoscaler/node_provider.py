@@ -1,0 +1,43 @@
+"""NodeProvider: the cloud-side plugin interface.
+
+Reference: python/ray/autoscaler/node_provider.py:13 — the v1 ABC every
+cloud implements (AWS/GCP/...); v2 wraps it in
+instance_manager/cloud_providers/. Here the surface is the minimal
+subset the reconciler needs; a GKE/GCE TPU provider implements it with
+instance-group calls, tests use FakeNodeProvider.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Launch/terminate/list cluster worker nodes.
+
+    Implementations must be thread-safe: the autoscaler calls from its
+    reconcile loop, tests may call concurrently.
+    """
+
+    def create_node(self, node_type: str, count: int = 1) -> List[str]:
+        """Launch ``count`` nodes of ``node_type``; returns provider ids.
+
+        May return before the node has joined the cluster — the
+        autoscaler treats a created-but-not-yet-registered node as
+        *pending* and avoids double-launching for the same demand.
+        """
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, dict]:
+        """provider_id -> {"node_type": str, "node_id": Optional[str]}.
+
+        ``node_id`` is the cluster node id once the node has registered
+        with the GCS (None while booting).
+        """
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass

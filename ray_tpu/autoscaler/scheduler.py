@@ -1,0 +1,134 @@
+"""Demand bin-packing: which nodes to launch for the pending work.
+
+Reference: python/ray/autoscaler/v2/scheduler.py:638
+(ResourceDemandScheduler) — bin-packs pending resource demand onto
+hypothetical nodes of each configured type, respecting per-type and
+cluster-wide caps. PG bundles are packed gang-style: all bundles of a
+pending placement group must fit on the hypothetical fleet or none are
+counted (a half-placed TPU slice gang is useless).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .config import AutoscalingConfig, NodeTypeConfig
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _subtract(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        if v > 0:
+            avail[k] = avail.get(k, 0.0) - v
+
+
+class ResourceDemandScheduler:
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+
+    def get_nodes_to_launch(
+        self,
+        pending_demand: List[Dict[str, float]],
+        pending_pg_bundles: List[List[Dict[str, float]]],
+        existing_avail: List[Dict[str, float]],
+        counts_by_type: Dict[str, int],
+    ) -> Dict[str, int]:
+        """Returns {node_type: count} to launch.
+
+        existing_avail: available resources of live + pending nodes (a
+        booting node contributes its full node-type resources so demand
+        already covered by an in-flight launch isn't double-served).
+        counts_by_type: current per-type worker counts incl. pending.
+        """
+        # Hypothetical fleet = copies of existing availabilities we can
+        # pack into, plus new nodes we decide to launch.
+        fleet: List[Dict[str, float]] = [dict(a) for a in existing_avail]
+        to_launch: Dict[str, int] = {}
+        counts = dict(counts_by_type)
+        total_workers = sum(counts.values())
+
+        def try_pack(shape: Dict[str, float]) -> bool:
+            nonlocal total_workers
+            if not shape:
+                return True
+            for avail in fleet:
+                if _fits(avail, shape):
+                    _subtract(avail, shape)
+                    return True
+            # Need a new node: pick the cheapest type that fits (fewest
+            # total resources — a stand-in for cost, deterministic).
+            best: Optional[NodeTypeConfig] = None
+            for nt in sorted(self.config.node_types.values(),
+                             key=lambda t: (sum(t.resources.values()),
+                                            t.name)):
+                if not _fits(nt.copy_resources(), shape):
+                    continue
+                if counts.get(nt.name, 0) >= nt.max_workers:
+                    continue
+                if total_workers >= self.config.max_workers:
+                    continue
+                best = nt
+                break
+            if best is None:
+                return False
+            avail = best.copy_resources()
+            _subtract(avail, shape)
+            fleet.append(avail)
+            to_launch[best.name] = to_launch.get(best.name, 0) + 1
+            counts[best.name] = counts.get(best.name, 0) + 1
+            total_workers += 1
+            return True
+
+        # min_workers floors first.
+        for nt in self.config.node_types.values():
+            deficit = nt.min_workers - counts.get(nt.name, 0)
+            for _ in range(max(0, deficit)):
+                if total_workers >= self.config.max_workers:
+                    break
+                fleet.append(nt.copy_resources())
+                to_launch[nt.name] = to_launch.get(nt.name, 0) + 1
+                counts[nt.name] = counts.get(nt.name, 0) + 1
+                total_workers += 1
+
+        # PG gangs: all-or-nothing (largest bundles first within a PG).
+        for bundles in pending_pg_bundles:
+            snapshot = ([dict(a) for a in fleet], dict(to_launch),
+                        dict(counts), total_workers)
+            ok = all(
+                try_pack(b)
+                for b in sorted(bundles,
+                                key=lambda b: -sum(b.values()))
+            )
+            if not ok:
+                fleet, to_launch, counts, total_workers = snapshot
+
+        # Individual task/actor shapes, largest first (better packing).
+        for shape in sorted(pending_demand, key=lambda s: -sum(s.values())):
+            try_pack(shape)
+
+        return to_launch
+
+    def get_nodes_to_terminate(
+        self,
+        node_idle: Dict[str, Tuple[str, float]],
+        counts_by_type: Dict[str, int],
+    ) -> List[str]:
+        """node_idle: provider_id -> (node_type, idle_duration_s).
+        Terminates nodes idle past the timeout, never dropping a type
+        below its min_workers."""
+        out: List[str] = []
+        counts = dict(counts_by_type)
+        for pid, (ntype, idle_s) in sorted(
+            node_idle.items(), key=lambda kv: -kv[1][1]
+        ):
+            if idle_s < self.config.idle_timeout_s:
+                continue
+            nt = self.config.node_types.get(ntype)
+            floor = nt.min_workers if nt else 0
+            if counts.get(ntype, 0) <= floor:
+                continue
+            out.append(pid)
+            counts[ntype] = counts.get(ntype, 0) - 1
+        return out

@@ -1,0 +1,148 @@
+"""Autoscaler tests: bin-packing unit tests + fake-provider e2e.
+
+Reference analogues: python/ray/tests/test_autoscaler_fake_multinode.py,
+test_autoscaler_fake_scaledown.py, v2 scheduler unit tests
+(python/ray/autoscaler/v2/tests/test_scheduler.py).
+"""
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalingConfig,
+    FakeNodeProvider,
+    NodeTypeConfig,
+    ResourceDemandScheduler,
+)
+from ray_tpu.cluster_utils import Cluster
+
+
+def _cfg(**kw):
+    defaults = dict(
+        node_types={
+            "cpu4": NodeTypeConfig("cpu4", {"CPU": 4}, max_workers=5),
+            "tpu_host": NodeTypeConfig(
+                "tpu_host", {"CPU": 8, "TPU": 4},
+                labels={"tpu-slice": "v5p-8"}, max_workers=3,
+            ),
+        },
+        max_workers=8,
+        idle_timeout_s=60.0,
+    )
+    defaults.update(kw)
+    return AutoscalingConfig(**defaults)
+
+
+class TestDemandScheduler:
+    def test_packs_onto_existing_capacity(self):
+        s = ResourceDemandScheduler(_cfg())
+        out = s.get_nodes_to_launch(
+            [{"CPU": 1}], [], [{"CPU": 2}], {"cpu4": 1})
+        assert out == {}
+
+    def test_launches_cheapest_fitting_type(self):
+        s = ResourceDemandScheduler(_cfg())
+        out = s.get_nodes_to_launch([{"CPU": 1}], [], [], {})
+        assert out == {"cpu4": 1}
+        out = s.get_nodes_to_launch([{"TPU": 2}], [], [], {})
+        assert out == {"tpu_host": 1}
+
+    def test_bin_packs_multiple_shapes_one_node(self):
+        s = ResourceDemandScheduler(_cfg())
+        out = s.get_nodes_to_launch(
+            [{"CPU": 2}, {"CPU": 1}, {"CPU": 1}], [], [], {})
+        assert out == {"cpu4": 1}
+
+    def test_respects_per_type_and_global_caps(self):
+        s = ResourceDemandScheduler(_cfg())
+        out = s.get_nodes_to_launch(
+            [{"CPU": 4}] * 10, [], [], {})
+        assert out.get("cpu4", 0) <= 5
+        total = sum(out.values())
+        assert total <= 8
+
+    def test_min_workers_floor(self):
+        cfg = _cfg()
+        cfg.node_types["cpu4"].min_workers = 2
+        s = ResourceDemandScheduler(cfg)
+        out = s.get_nodes_to_launch([], [], [], {})
+        assert out == {"cpu4": 2}
+
+    def test_pg_gang_all_or_nothing(self):
+        # 4 TPU bundles fit on one tpu_host... but 5 bundles of TPU:4
+        # need 5 hosts and max is 3: gang must launch nothing.
+        s = ResourceDemandScheduler(_cfg())
+        out = s.get_nodes_to_launch(
+            [], [[{"TPU": 4}] * 5], [], {})
+        assert out == {}
+        out = s.get_nodes_to_launch(
+            [], [[{"TPU": 4}] * 2], [], {})
+        assert out == {"tpu_host": 2}
+
+    def test_terminate_idle_respects_min_workers(self):
+        cfg = _cfg(idle_timeout_s=10.0)
+        cfg.node_types["cpu4"].min_workers = 1
+        s = ResourceDemandScheduler(cfg)
+        kills = s.get_nodes_to_terminate(
+            {"a": ("cpu4", 100.0), "b": ("cpu4", 200.0),
+             "c": ("cpu4", 5.0)},
+            {"cpu4": 3},
+        )
+        # c is not idle long enough; a+b both die, leaving 1 >= floor
+        assert kills == ["b", "a"]
+        kills = s.get_nodes_to_terminate(
+            {"a": ("cpu4", 100.0), "b": ("cpu4", 200.0)},
+            {"cpu4": 2},
+        )
+        # with only 2 nodes, the floor spares the less-idle one
+        assert kills == ["b"]
+
+
+@pytest.fixture(scope="module")
+def scaling_cluster():
+    c = Cluster(head_node_args={"resources": {"CPU": 2}})
+    ray.init(address=c.address)
+    cfg = AutoscalingConfig(
+        node_types={
+            "worker": NodeTypeConfig(
+                "worker", {"CPU": 2, "widget": 2}, max_workers=3),
+        },
+        max_workers=3,
+        idle_timeout_s=3.0,
+        update_interval_s=0.25,
+    )
+    provider = FakeNodeProvider(
+        cfg, c.gcs_address, session_dir=c.head_node.session_dir)
+    import ray_tpu.api as api
+
+    scaler = Autoscaler(cfg, provider, api.global_worker().gcs).start()
+    yield c, provider, scaler
+    scaler.stop()
+    provider.shutdown()
+    ray.shutdown()
+    c.shutdown()
+
+
+@ray.remote
+def use_widget():
+    return "made"
+
+
+def test_scale_up_on_infeasible_task(scaling_cluster):
+    _c, provider, _s = scaling_cluster
+    # Requires a resource no live node has -> autoscaler must launch.
+    ref = use_widget.options(resources={"widget": 1}).remote()
+    assert ray.get(ref, timeout=120) == "made"
+    assert len(provider.non_terminated_nodes()) >= 1
+
+
+def test_scale_down_after_idle(scaling_cluster):
+    _c, provider, _s = scaling_cluster
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.5)
+    assert provider.non_terminated_nodes() == {}
