@@ -2876,6 +2876,14 @@ class _ActorSubmitter:
             await self._pump()
             return
         except (RpcConnectionError, Exception) as e:  # actor process gone
+            rec = w._tasks.get(spec["task_id"])
+            if rec is not None and rec.status == "FINISHED":
+                # executed + streamed before the drop: neither a retry
+                # (duplicate side effects) nor a failure
+                with self.lock:
+                    self.address = None
+                    self.state = "PENDING"
+                return
             retriable = spec.get("_retries", 0) > 0
             with self.lock:
                 self.address = None

@@ -312,8 +312,10 @@ class Raylet:
         """Seconds this node has been fully idle (autoscaler scale-down
         signal; reference: autoscaler v2 reads per-node idle from the GCS
         resource report)."""
+        # resources_fit is _EPS-tolerant: float drift from fractional
+        # lease release must not report a free node as busy forever
         busy = (
-            self.available != self.total
+            not resources_fit(self.available, self.total)
             or bool(self._lease_waiters)
         )
         if busy:

@@ -60,6 +60,7 @@ class Autoscaler:
             elif info is not None and not info["alive"]:
                 # dead in GCS: reclaim the instance
                 self.provider.terminate_node(pid)
+                self._launch_ts.pop(pid, None)
                 counts[ntype] -= 1
             else:
                 # still booting: counts toward capacity with its full
@@ -85,7 +86,11 @@ class Autoscaler:
                 self._launch_ts[pid] = now
 
         to_kill = []
-        if not to_launch and not state["pending_demand"]:
+        if (
+            not to_launch
+            and not state["pending_demand"]
+            and not state["pending_pg_bundles"]
+        ):
             to_kill = self.scheduler.get_nodes_to_terminate(
                 node_idle, counts
             )

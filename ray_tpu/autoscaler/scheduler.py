@@ -11,17 +11,9 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from .._private.scheduling import resources_fit as _fits
+from .._private.scheduling import subtract as _subtract
 from .config import AutoscalingConfig, NodeTypeConfig
-
-
-def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
-    return all(avail.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
-
-
-def _subtract(avail: Dict[str, float], demand: Dict[str, float]) -> None:
-    for k, v in demand.items():
-        if v > 0:
-            avail[k] = avail.get(k, 0.0) - v
 
 
 class ResourceDemandScheduler:

@@ -1,0 +1,30 @@
+"""RLlib-equivalent: distributed reinforcement learning, JAX-native.
+
+Reference: rllib/algorithms/algorithm.py:207 (Algorithm driver),
+rllib/env/env_runner_group.py:71 (EnvRunnerGroup of rollout actors),
+rllib/core/learner/learner_group.py:100 + learner.py:107 (Learner DDP),
+rllib/core/rl_module/ (RLModule model abstraction).
+
+TPU-native reframing: the reference wraps torch modules and NCCL DDP;
+here models are pure-jax param pytrees, the update step is one jitted
+function (minibatch SGD via lax.scan, GAE via lax.scan — no Python
+loops in the hot path), rollout inference is a jitted policy on the
+env-runner host, and multi-learner data parallelism averages grads
+through the object store (host plane) or a jax mesh (device plane).
+"""
+from .spaces import Box, Discrete
+from .env import Env, VectorEnv, register_env, make_env
+from .sample_batch import SampleBatch
+from .rl_module import ActorCriticModule, QModule
+from .env_runner import EnvRunner
+from .learner import Learner, LearnerGroup
+from .config import AlgorithmConfig
+from .algorithm import Algorithm
+from .algorithms import PPO, PPOConfig, DQN, DQNConfig
+
+__all__ = [
+    "Box", "Discrete", "Env", "VectorEnv", "register_env", "make_env",
+    "SampleBatch", "ActorCriticModule", "QModule", "EnvRunner",
+    "Learner", "LearnerGroup", "AlgorithmConfig", "Algorithm",
+    "PPO", "PPOConfig", "DQN", "DQNConfig",
+]

@@ -1,0 +1,183 @@
+"""Algorithm: the driver loop — sample, learn, report, checkpoint.
+
+Reference: rllib/algorithms/algorithm.py:207 (Algorithm.step :986 —
+parallel sampling via EnvRunnerGroup then LearnerGroup.update;
+training_step :2047), checkpointing via Checkpointable
+(rllib/utils/checkpoints.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import AlgorithmConfig
+from .env_runner import EnvRunner
+from .learner import LearnerGroup
+from .sample_batch import SampleBatch
+
+
+class Algorithm:
+    learner_cls = None  # set by subclass
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._total_steps = 0
+        runner_cfg = config.to_dict()
+        self._module = self._build_module()
+        # env runners: local (0) or actor fan-out
+        if config.num_env_runners == 0:
+            self._runners: List = [EnvRunner(runner_cfg,
+                                             seed=config.seed)]
+            self._remote = False
+        else:
+            import ray_tpu as ray
+
+            cls = ray.remote(EnvRunner)
+            self._runners = [
+                cls.remote(runner_cfg, seed=config.seed + i)
+                for i in range(config.num_env_runners)
+            ]
+            self._remote = True
+            ray.get([r.set_module.remote(self._module)
+                     for r in self._runners])
+        if not self._remote:
+            self._runners[0].set_module(self._module)
+        self.learner_group = LearnerGroup(
+            self.learner_cls, self._module, runner_cfg,
+            num_learners=config.num_learners,
+        )
+        self._sync_weights()
+
+    # -- subclass hooks -----------------------------------------------
+    def _build_module(self):
+        raise NotImplementedError
+
+    def training_step(self, train_batch: SampleBatch) -> Dict:
+        return self.learner_group.update(train_batch)
+
+    def _exploration_epsilon(self) -> Optional[float]:
+        return None  # value-based algos override
+
+    # -- driver loop --------------------------------------------------
+    def _sync_weights(self):
+        w = self.learner_group.get_weights()
+        eps = self._exploration_epsilon()
+        if self._remote:
+            import ray_tpu as ray
+
+            ray.get([r.set_weights.remote(w, eps)
+                     for r in self._runners])
+        else:
+            self._runners[0].set_weights(w, eps)
+
+    def _sample(self) -> SampleBatch:
+        frag = self.config.rollout_fragment_length
+        if self._remote:
+            import ray_tpu as ray
+
+            batches = ray.get([r.sample.remote(frag)
+                               for r in self._runners])
+        else:
+            batches = [self._runners[0].sample(frag)]
+        return batches
+
+    def _episode_stats(self) -> Dict:
+        if self._remote:
+            import ray_tpu as ray
+
+            stats = ray.get([r.episode_stats.remote()
+                             for r in self._runners])
+        else:
+            stats = [self._runners[0].episode_stats()]
+        rets = [r for s in stats for r in s["episode_returns"]]
+        lens = [l for s in stats for l in s["episode_lengths"]]
+        return {
+            "episode_return_mean": (
+                float(np.mean(rets)) if rets else float("nan")),
+            "episode_len_mean": (
+                float(np.mean(lens)) if lens else float("nan")),
+            "num_episodes": len(rets),
+        }
+
+    def train(self) -> Dict:
+        """One iteration: rollout -> update -> metrics (reference:
+        Algorithm.step)."""
+        t0 = time.monotonic()
+        self._sync_weights()
+        batches = self._sample()
+        sampled = sum(b.count for b in batches)
+        self._total_steps += sampled
+        learn = self.training_step_from_rollouts(batches)
+        self.iteration += 1
+        res = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "time_this_iter_s": time.monotonic() - t0,
+            **self._episode_stats(),
+            **{f"learner/{k}": v for k, v in learn.items()},
+        }
+        return res
+
+    def training_step_from_rollouts(self, batches) -> Dict:
+        return self.training_step(SampleBatch.concat(batches))
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        self._sync_weights()
+        if self._remote:
+            import ray_tpu as ray
+
+            return ray.get(
+                self._runners[0].evaluate.remote(num_episodes))
+        return self._runners[0].evaluate(num_episodes)
+
+    # -- checkpointing ------------------------------------------------
+    def save(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "total_steps": self._total_steps,
+            "algo_state": self._algo_state(),
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+        with open(os.path.join(checkpoint_dir, "config.json"), "w") as f:
+            json.dump(
+                {k: v for k, v in self.config.to_dict().items()
+                 if isinstance(v, (int, float, str, bool, list, dict,
+                                   tuple, type(None)))},
+                f, default=str)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_steps = state["total_steps"]
+        self._restore_algo_state(state.get("algo_state", {}))
+        self._sync_weights()
+
+    def _algo_state(self) -> dict:
+        return {}
+
+    def _restore_algo_state(self, state: dict) -> None:
+        pass
+
+    def stop(self):
+        if self._remote:
+            import ray_tpu as ray
+
+            for r in self._runners:
+                try:
+                    ray.kill(r)
+                except Exception:
+                    pass

@@ -1,0 +1,4 @@
+from .ppo import PPO, PPOConfig
+from .dqn import DQN, DQNConfig
+
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig"]

@@ -1,0 +1,207 @@
+"""DQN: double Q-learning with a target network + replay buffer.
+
+Reference: rllib/algorithms/dqn/dqn.py (DQNConfig/DQN) +
+dqn/torch/dqn_torch_learner.py (the TD loss). The replay buffer is a
+host-side numpy ring (reference: utils/replay_buffers/); the TD update
+is one jitted call; the target net syncs every N updates.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..algorithm import Algorithm
+from ..config import AlgorithmConfig
+from ..env import make_env
+from ..learner import Learner
+from ..rl_module import QModule
+from ..sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch,
+)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size = 50_000
+        self.learning_starts = 1_000
+        self.target_update_freq = 500   # in gradient updates
+        self.num_updates_per_iter = 32
+        self.batch_size = 64
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_decay_steps = 10_000
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class ReplayBuffer:
+    """Uniform ring buffer (reference:
+    rllib/utils/replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self.pos = 0
+        self.size = 0
+
+    def add_batch(self, batch: SampleBatch):
+        n = batch.count
+        idx = (self.pos + np.arange(n)) % self.capacity
+        self.obs[idx] = batch[OBS]
+        self.next_obs[idx] = batch[NEXT_OBS]
+        self.actions[idx] = batch[ACTIONS]
+        self.rewards[idx] = batch[REWARDS]
+        self.dones[idx] = np.asarray(batch[DONES], np.float32)
+        self.pos = int((self.pos + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample(self, rng: np.random.Generator, n: int) -> dict:
+        idx = rng.integers(0, self.size, n)
+        return {
+            OBS: self.obs[idx],
+            NEXT_OBS: self.next_obs[idx],
+            ACTIONS: self.actions[idx],
+            REWARDS: self.rewards[idx],
+            DONES: self.dones[idx],
+        }
+
+
+class DQNLearner(Learner):
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 10.0)),
+            optax.adam(config.get("lr", 1e-3)),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self.buffer = ReplayBuffer(
+            config.get("buffer_size", 50_000), module.obs_dim)
+        self._rng = np.random.default_rng(seed)
+        self._updates = 0
+        gamma = config.get("gamma", 0.99)
+
+        def td_step(params, opt_state, target_params, mb):
+            def loss_fn(p):
+                q = self.module.q_values(p, mb[OBS])
+                q_sel = q[jnp.arange(q.shape[0]),
+                          mb[ACTIONS].astype(jnp.int32)]
+                # double-DQN: online net picks, target net evaluates
+                next_a = jnp.argmax(
+                    self.module.q_values(p, mb[NEXT_OBS]), axis=-1)
+                next_q = self.module.q_values(
+                    target_params, mb[NEXT_OBS])[
+                    jnp.arange(q.shape[0]), next_a]
+                target = (mb[REWARDS]
+                          + gamma * (1.0 - mb[DONES])
+                          * jax.lax.stop_gradient(next_q))
+                return jnp.mean((q_sel - target) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._td_jit = jax.jit(td_step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        self.buffer.add_batch(batch)
+        if self.buffer.size < self.config.get("learning_starts", 1000):
+            return {"td_loss": float("nan"),
+                    "buffer_size": float(self.buffer.size)}
+        n_updates = self.config.get("num_updates_per_iter", 32)
+        bs = self.config.get("batch_size", 64)
+        loss = jnp.zeros(())
+        for _ in range(n_updates):
+            mb = {k: jnp.asarray(v) for k, v in
+                  self.buffer.sample(self._rng, bs).items()}
+            self.params, self.opt_state, loss = self._td_jit(
+                self.params, self.opt_state, self.target_params, mb)
+            self._updates += 1
+            if self._updates % self.config.get(
+                    "target_update_freq", 500) == 0:
+                self.target_params = jax.tree_util.tree_map(
+                    jnp.copy, self.params)
+        self._metrics = {"td_loss": float(loss),
+                         "buffer_size": float(self.buffer.size),
+                         "num_updates": float(self._updates)}
+        return dict(self._metrics)
+
+    # DDP: each learner owns a buffer shard; grads from its own sample
+    def compute_grads(self, batch: SampleBatch):
+        self.buffer.add_batch(batch)
+        if self.buffer.size < max(
+                64, self.config.get("learning_starts", 1000)
+                // max(1, self.config.get("num_learners", 1))):
+            return jax.tree_util.tree_map(jnp.zeros_like, self.params)
+        mb = {k: jnp.asarray(v) for k, v in self.buffer.sample(
+            self._rng, self.config.get("batch_size", 64)).items()}
+        gamma = self.config.get("gamma", 0.99)
+
+        def loss_fn(p):
+            q = self.module.q_values(p, mb[OBS])
+            q_sel = q[jnp.arange(q.shape[0]),
+                      mb[ACTIONS].astype(jnp.int32)]
+            next_a = jnp.argmax(
+                self.module.q_values(p, mb[NEXT_OBS]), axis=-1)
+            next_q = self.module.q_values(
+                self.target_params, mb[NEXT_OBS])[
+                jnp.arange(q.shape[0]), next_a]
+            target = (mb[REWARDS] + gamma * (1.0 - mb[DONES])
+                      * jax.lax.stop_gradient(next_q))
+            return jnp.mean((q_sel - target) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(self.params)
+        self._metrics = {"td_loss": float(loss)}
+        self._updates += 1
+        if self._updates % self.config.get(
+                "target_update_freq", 500) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        return jax.device_get(grads)
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state: dict) -> bool:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.device_put(state["target_params"])
+        self._updates = state.get("updates", 0)
+        return True
+
+
+class DQN(Algorithm):
+    learner_cls = DQNLearner
+
+    def _build_module(self):
+        probe = make_env(self.config.env, **self.config.env_config)
+        return QModule(probe.observation_space, probe.action_space,
+                       hiddens=self.config.hiddens)
+
+    def _exploration_epsilon(self) -> Optional[float]:
+        c = self.config
+        frac = min(1.0, self._total_steps
+                   / max(1, c.epsilon_decay_steps))
+        return float(c.epsilon_initial
+                     + frac * (c.epsilon_final - c.epsilon_initial))
+
+    def _algo_state(self) -> dict:
+        return {"total_steps": self._total_steps}

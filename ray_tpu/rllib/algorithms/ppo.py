@@ -1,0 +1,250 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Reference: rllib/algorithms/ppo/ppo.py (PPOConfig/PPO) +
+ppo/torch/ppo_torch_learner.py (the loss). TPU-first: GAE and all
+minibatch-SGD epochs run inside ONE jitted call — advantages via
+lax.scan over the time axis, epoch/minibatch loop via lax.scan over
+precomputed shuffle indices — so an update is a single XLA program
+with no host round-trips.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..algorithm import Algorithm
+from ..config import AlgorithmConfig
+from ..env import make_env
+from ..learner import Learner
+from ..rl_module import ActorCriticModule
+from ..sample_batch import (
+    ACTIONS, DONES, LOGP, OBS, REWARDS, SampleBatch, VALUES,
+)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_epochs = 8
+        self.minibatch_size = 128
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.lambda_ = 0.95
+        self.lr = 3e-4
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+def _gae(rewards, values, dones, last_values, gamma, lam):
+    """[T, B] inputs -> (advantages, targets), lax.scan over time."""
+
+    def step(carry, xs):
+        r, v, d = xs
+        next_v, adv = carry
+        delta = r + gamma * next_v * (1.0 - d) - v
+        adv = delta + gamma * lam * (1.0 - d) * adv
+        return (v, adv), adv
+
+    (_, _), advs = jax.lax.scan(
+        step,
+        (last_values, jnp.zeros_like(last_values)),
+        (rewards, values, dones.astype(jnp.float32)),
+        reverse=True,
+    )
+    return advs, advs + values
+
+
+class PPOLearner(Learner):
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        self._update_jit = jax.jit(partial(
+            self._update_impl,
+            gamma=config.get("gamma", 0.99),
+            lam=config.get("lambda_", 0.95),
+            clip=config.get("clip_param", 0.2),
+            vf_clip=config.get("vf_clip_param", 10.0),
+            vf_coeff=config.get("vf_loss_coeff", 0.5),
+            ent_coeff=config.get("entropy_coeff", 0.0),
+        ))
+
+    # one jitted program: GAE + epochs x minibatches of SGD
+    def _update_impl(self, params, opt_state, batch, idx, *, gamma, lam,
+                     clip, vf_clip, vf_coeff, ent_coeff):
+        T, B = batch["rewards"].shape
+        last_values = self.module.value(
+            params, batch["last_obs"])  # bootstrap
+        advs, targets = _gae(
+            batch["rewards"], batch["values"], batch["dones"],
+            last_values, gamma, lam)
+        flat = {
+            OBS: batch[OBS].reshape(T * B, -1),
+            ACTIONS: batch[ACTIONS].reshape(
+                (T * B,) + batch[ACTIONS].shape[2:]),
+            LOGP: batch[LOGP].reshape(T * B),
+            VALUES: batch[VALUES].reshape(T * B),
+            "advantages": advs.reshape(T * B),
+            "targets": targets.reshape(T * B),
+        }
+        a = flat["advantages"]
+        flat["advantages"] = (a - a.mean()) / (a.std() + 1e-8)
+
+        def loss_fn(p, mb):
+            logp = self.module.logp(p, mb[OBS], mb[ACTIONS])
+            ratio = jnp.exp(logp - mb[LOGP])
+            surr = jnp.minimum(
+                ratio * mb["advantages"],
+                jnp.clip(ratio, 1 - clip, 1 + clip) * mb["advantages"],
+            )
+            vf = self.module.value(p, mb[OBS])
+            vf_err = jnp.clip((vf - mb["targets"]) ** 2, 0.0,
+                              vf_clip ** 2)
+            ent = self.module.entropy(p, mb[OBS])
+            loss = (
+                -surr.mean()
+                + vf_coeff * vf_err.mean()
+                - ent_coeff * ent.mean()
+            )
+            return loss, (jnp.abs(ratio - 1.0).mean(), vf_err.mean(),
+                          ent.mean())
+
+        def sgd_step(carry, mb_idx):
+            p, o = carry
+            mb = jax.tree_util.tree_map(lambda x: x[mb_idx], flat)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, mb)
+            updates, o = self.optimizer.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o), (loss, *aux)
+
+        (params, opt_state), stats = jax.lax.scan(
+            sgd_step, (params, opt_state), idx)
+        loss, ratio_dev, vf_err, ent = (s[-1] for s in stats)
+        return params, opt_state, {
+            "total_loss": loss,
+            "ratio_deviation": ratio_dev,
+            "vf_loss": vf_err,
+            "entropy": ent,
+        }
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        T, B = (int(x) for x in batch["t_b_shape"][:2])
+        epochs = self.config.get("num_epochs", 8)
+        mb_size = min(self.config.get("minibatch_size", 128), T * B)
+        n_mb = max(1, (T * B) // mb_size)
+        self.key, sub = jax.random.split(self.key)
+        idx = jax.random.permutation(
+            sub, jnp.tile(jnp.arange(n_mb * mb_size), (epochs, 1)),
+            axis=1, independent=True,
+        ).reshape(epochs * n_mb, mb_size)
+        dev_batch = {
+            OBS: jnp.asarray(batch[OBS]).reshape(T, B, -1),
+            ACTIONS: jnp.asarray(batch[ACTIONS]).reshape(
+                (T, B) + np.asarray(batch[ACTIONS]).shape[1:]),
+            LOGP: jnp.asarray(batch[LOGP]).reshape(T, B),
+            VALUES: jnp.asarray(batch[VALUES]).reshape(T, B),
+            REWARDS: jnp.asarray(batch[REWARDS]).reshape(T, B),
+            DONES: jnp.asarray(batch[DONES]).reshape(T, B),
+            "last_obs": jnp.asarray(batch["next_obs"][-B:]),
+        }
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state, dev_batch, idx)
+        self._metrics = {k: float(v) for k, v in metrics.items()}
+        return dict(self._metrics)
+
+    # DDP shards: single-epoch full-batch grads (group averages them).
+    # Shards arrive env-axis-split (SampleBatch.split keeps whole
+    # trajectories), so [T, B'] structure is intact for GAE.
+    def compute_grads(self, batch: SampleBatch):
+        t, b = (int(x) for x in batch["t_b_shape"][:2])
+        dev_batch = {
+            OBS: jnp.asarray(batch[OBS]).reshape(t, b, -1),
+            ACTIONS: jnp.asarray(batch[ACTIONS]).reshape(
+                (t, b) + np.asarray(batch[ACTIONS]).shape[1:]),
+            LOGP: jnp.asarray(batch[LOGP]).reshape(t, b),
+            VALUES: jnp.asarray(batch[VALUES]).reshape(t, b),
+            REWARDS: jnp.asarray(batch[REWARDS]).reshape(t, b),
+            DONES: jnp.asarray(batch[DONES]).reshape(t, b),
+            "last_obs": jnp.asarray(batch["next_obs"][-b:]),
+        }
+        grads, metrics = self._grads_jit(self.params, dev_batch)
+        self._metrics = {k: float(v) for k, v in metrics.items()}
+        return jax.device_get(grads)
+
+    @property
+    def _grads_jit(self):
+        if not hasattr(self, "_grads_fn"):
+            gamma = self.config.get("gamma", 0.99)
+            lam = self.config.get("lambda_", 0.95)
+            clip = self.config.get("clip_param", 0.2)
+
+            def fn(params, batch):
+                T, B = batch["rewards"].shape
+                last_values = self.module.value(params, batch["last_obs"])
+                advs, targets = _gae(
+                    batch["rewards"], batch["values"], batch["dones"],
+                    last_values, gamma, lam)
+                obs = batch[OBS].reshape(T * B, -1)
+                acts = batch[ACTIONS].reshape(
+                    (T * B,) + batch[ACTIONS].shape[2:])
+                old_logp = batch[LOGP].reshape(T * B)
+                a = advs.reshape(T * B)
+                a = (a - a.mean()) / (a.std() + 1e-8)
+                tg = targets.reshape(T * B)
+
+                def loss_fn(p):
+                    logp = self.module.logp(p, obs, acts)
+                    ratio = jnp.exp(logp - old_logp)
+                    surr = jnp.minimum(
+                        ratio * a,
+                        jnp.clip(ratio, 1 - clip, 1 + clip) * a)
+                    vf = self.module.value(p, obs)
+                    return (-surr.mean()
+                            + 0.5 * ((vf - tg) ** 2).mean())
+
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                return grads, {"total_loss": loss}
+
+            self._grads_fn = jax.jit(fn)
+        return self._grads_fn
+
+
+class PPO(Algorithm):
+    learner_cls = PPOLearner
+
+    def _build_module(self):
+        probe = make_env(self.config.env, **self.config.env_config)
+        return ActorCriticModule(
+            probe.observation_space, probe.action_space,
+            hiddens=self.config.hiddens)
+
+    def training_step_from_rollouts(self, batches) -> Dict:
+        """Merge runner batches along the env axis so the combined
+        batch keeps [T, R*B] trajectory structure (plain concat would
+        interleave timesteps of different runners)."""
+        T, B = (int(x) for x in np.asarray(batches[0]["t_b_shape"])[:2])
+        R = len(batches)
+        if R == 1:
+            return self.training_step(batches[0])
+        merged = {}
+        for k in batches[0]:
+            if k == "t_b_shape":
+                continue
+            cols = [
+                np.asarray(b[k]).reshape(
+                    (T, B) + np.asarray(b[k]).shape[1:])
+                for b in batches
+            ]
+            cat = np.concatenate(cols, axis=1)
+            merged[k] = cat.reshape((T * R * B,) + cat.shape[2:])
+        sb = SampleBatch(merged)
+        sb["t_b_shape"] = np.asarray([T, R * B])
+        return self.training_step(sb)

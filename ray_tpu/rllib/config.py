@@ -1,0 +1,77 @@
+"""AlgorithmConfig: the builder-pattern config object.
+
+Reference: rllib/algorithms/algorithm_config.py (AlgorithmConfig —
+.environment() .env_runners() .training() .learners() chained setters,
+.build_algo() at the end).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional, Type
+
+
+class AlgorithmConfig:
+    algo_class: Optional[Type] = None
+
+    def __init__(self):
+        self.env: Optional[str] = None
+        self.env_config: dict = {}
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 8
+        self.rollout_fragment_length: int = 64
+        self.num_learners: int = 0
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 512
+        self.grad_clip: float = 10.0
+        self.hiddens: tuple = (64, 64)
+        self.seed: int = 0
+
+    # -- chained setters ----------------------------------------------
+    def environment(self, env: str, env_config: Optional[dict] = None):
+        self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, num_learners: Optional[int] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- materialize --------------------------------------------------
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in vars(self).items()}
+
+    def build_algo(self):
+        assert self.algo_class is not None, "use a concrete config"
+        return self.algo_class(self.copy())
+
+    # reference spells it build() in older releases
+    build = build_algo

@@ -1,0 +1,256 @@
+"""Environment API + built-in vectorized benchmark envs.
+
+Reference: rllib/env/ (EnvRunner wraps gymnasium vector envs;
+rllib/examples/envs has the classic-control tasks). No gymnasium in
+this image, so CartPole and Pendulum are implemented here directly as
+*batched numpy* dynamics — the whole vector steps in one ufunc pass,
+which is both faster than a Python loop over envs and mirrors how a
+TPU-resident env would batch.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .spaces import Box, Discrete
+
+_ENV_REGISTRY: Dict[str, Callable[..., "Env"]] = {}
+
+
+def register_env(name: str, creator: Callable[..., "Env"]) -> None:
+    """Reference: ray.tune.register_env — name -> creator for configs."""
+    _ENV_REGISTRY[name] = creator
+
+
+def make_env(name: str, **kwargs) -> "Env":
+    if name in _ENV_REGISTRY:
+        return _ENV_REGISTRY[name](**kwargs)
+    raise KeyError(
+        f"unknown env {name!r}; registered: {sorted(_ENV_REGISTRY)}"
+    )
+
+
+class Env:
+    """Single-env API (gymnasium-shaped: reset/step, 5-tuple step)."""
+
+    observation_space: Box
+    action_space: object
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action):
+        """-> (obs, reward, terminated, truncated, info)"""
+        raise NotImplementedError
+
+
+class VectorEnv:
+    """Batch-of-envs with auto-reset on episode end.
+
+    Built-in envs implement batched dynamics natively (`_step_batch`);
+    arbitrary single envs are wrapped with a Python loop fallback.
+    """
+
+    def __init__(self, creator: Callable[[], Env], num_envs: int,
+                 seed: int = 0):
+        probe = creator()
+        self.observation_space = probe.observation_space
+        self.action_space = probe.action_space
+        self.num_envs = num_envs
+        if isinstance(probe, _BatchedEnv):
+            self._batched = type(probe)(batch=num_envs)
+            self._envs = None
+        else:
+            self._batched = None
+            self._envs = [probe] + [creator() for _ in range(num_envs - 1)]
+        self._rng = np.random.default_rng(seed)
+        self._ep_ret = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self.episode_returns: list = []  # completed-episode returns
+        self.episode_lengths: list = []
+
+    def reset(self, seed: Optional[int] = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        if self._batched is not None:
+            return self._batched.reset_batch(self._rng)
+        return np.stack([
+            e.reset(seed=int(self._rng.integers(2**31)))
+            for e in self._envs
+        ])
+
+    def step(self, actions: np.ndarray):
+        """-> (obs, rewards, dones); finished sub-envs auto-reset, their
+        returns recorded in episode_returns."""
+        if self._batched is not None:
+            obs, rew, term, trunc = self._batched.step_batch(
+                actions, self._rng)
+        else:
+            obs_l, rew_l, term_l, trunc_l = [], [], [], []
+            for e, a in zip(self._envs, actions):
+                o, r, t, tr, _ = e.step(a)
+                obs_l.append(o); rew_l.append(r)
+                term_l.append(t); trunc_l.append(tr)
+            obs = np.stack(obs_l)
+            rew = np.asarray(rew_l, np.float32)
+            term = np.asarray(term_l)
+            trunc = np.asarray(trunc_l)
+        done = term | trunc
+        self._ep_ret += rew
+        self._ep_len += 1
+        if done.any():
+            for i in np.flatnonzero(done):
+                self.episode_returns.append(float(self._ep_ret[i]))
+                self.episode_lengths.append(int(self._ep_len[i]))
+            self._ep_ret[done] = 0.0
+            self._ep_len[done] = 0
+            if self._batched is not None:
+                obs = self._batched.reset_where(obs, done, self._rng)
+            else:
+                for i in np.flatnonzero(done):
+                    obs[i] = self._envs[i].reset(
+                        seed=int(self._rng.integers(2**31)))
+        return obs, rew, done
+
+    def pop_episode_stats(self):
+        rets, lens = self.episode_returns, self.episode_lengths
+        self.episode_returns, self.episode_lengths = [], []
+        return rets, lens
+
+
+class _BatchedEnv(Env):
+    """Envs whose dynamics vectorize over a batch axis natively."""
+
+    def __init__(self, batch: int = 1):
+        self.batch = batch
+
+    def reset_batch(self, rng) -> np.ndarray:
+        raise NotImplementedError
+
+    def step_batch(self, actions, rng):
+        raise NotImplementedError
+
+    def reset_where(self, obs, done, rng) -> np.ndarray:
+        raise NotImplementedError
+
+    # single-env API falls out of the batched one
+    def reset(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        return self.reset_batch(rng)[0]
+
+    def step(self, action):
+        obs, rew, term, trunc = self.step_batch(
+            np.asarray([action]), np.random.default_rng(0))
+        return obs[0], float(rew[0]), bool(term[0]), bool(trunc[0]), {}
+
+
+class CartPole(_BatchedEnv):
+    """Classic cart-pole balance, standard gymnasium-v1 constants
+    (max 500 steps, reward 1/step)."""
+
+    GRAVITY, MASSCART, MASSPOLE = 9.8, 1.0, 0.1
+    LENGTH, FORCE_MAG, TAU = 0.5, 10.0, 0.02
+    THETA_LIMIT, X_LIMIT, MAX_STEPS = 12 * np.pi / 180, 2.4, 500
+
+    observation_space = Box(-np.inf, np.inf, (4,))
+    action_space = Discrete(2)
+
+    def __init__(self, batch: int = 1):
+        super().__init__(batch)
+        self._state = np.zeros((batch, 4), np.float64)
+        self._t = np.zeros(batch, np.int64)
+
+    def reset_batch(self, rng) -> np.ndarray:
+        self._state = rng.uniform(-0.05, 0.05, (self.batch, 4))
+        self._t[:] = 0
+        return self._state.astype(np.float32)
+
+    def step_batch(self, actions, rng):
+        x, x_dot, th, th_dot = self._state.T
+        force = np.where(np.asarray(actions) == 1,
+                         self.FORCE_MAG, -self.FORCE_MAG)
+        costh, sinth = np.cos(th), np.sin(th)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+        temp = (force + polemass_length * th_dot**2 * sinth) / total_mass
+        th_acc = (self.GRAVITY * sinth - costh * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costh**2 / total_mass)
+        )
+        x_acc = temp - polemass_length * th_acc * costh / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * x_acc
+        th = th + self.TAU * th_dot
+        th_dot = th_dot + self.TAU * th_acc
+        self._state = np.stack([x, x_dot, th, th_dot], axis=1)
+        self._t += 1
+        term = (np.abs(x) > self.X_LIMIT) | (np.abs(th) > self.THETA_LIMIT)
+        trunc = self._t >= self.MAX_STEPS
+        rew = np.ones(self.batch, np.float32)
+        return self._state.astype(np.float32), rew, term, trunc
+
+    def reset_where(self, obs, done, rng) -> np.ndarray:
+        idx = np.flatnonzero(done)
+        self._state[idx] = rng.uniform(-0.05, 0.05, (len(idx), 4))
+        self._t[idx] = 0
+        obs = obs.copy()
+        obs[idx] = self._state[idx].astype(np.float32)
+        return obs
+
+
+class Pendulum(_BatchedEnv):
+    """Torque-controlled pendulum swing-up (continuous actions)."""
+
+    MAX_SPEED, MAX_TORQUE, DT, G, M, L = 8.0, 2.0, 0.05, 10.0, 1.0, 1.0
+    MAX_STEPS = 200
+
+    observation_space = Box(-np.inf, np.inf, (3,))
+    action_space = Box(-2.0, 2.0, (1,))
+
+    def __init__(self, batch: int = 1):
+        super().__init__(batch)
+        self._th = np.zeros(batch)
+        self._thdot = np.zeros(batch)
+        self._t = np.zeros(batch, np.int64)
+
+    def _obs(self):
+        return np.stack(
+            [np.cos(self._th), np.sin(self._th), self._thdot], axis=1
+        ).astype(np.float32)
+
+    def reset_batch(self, rng) -> np.ndarray:
+        self._th = rng.uniform(-np.pi, np.pi, self.batch)
+        self._thdot = rng.uniform(-1.0, 1.0, self.batch)
+        self._t[:] = 0
+        return self._obs()
+
+    def step_batch(self, actions, rng):
+        u = np.clip(np.asarray(actions).reshape(self.batch),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = self._th, self._thdot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (
+            3 * self.G / (2 * self.L) * np.sin(th)
+            + 3.0 / (self.M * self.L**2) * u
+        ) * self.DT
+        thdot = np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._th = th + thdot * self.DT
+        self._thdot = thdot
+        self._t += 1
+        trunc = self._t >= self.MAX_STEPS
+        term = np.zeros(self.batch, bool)
+        return self._obs(), (-cost).astype(np.float32), term, trunc
+
+    def reset_where(self, obs, done, rng) -> np.ndarray:
+        idx = np.flatnonzero(done)
+        self._th[idx] = rng.uniform(-np.pi, np.pi, len(idx))
+        self._thdot[idx] = rng.uniform(-1.0, 1.0, len(idx))
+        self._t[idx] = 0
+        obs = obs.copy()
+        obs[idx] = self._obs()[idx]
+        return obs
+
+
+register_env("CartPole-v1", CartPole)
+register_env("Pendulum-v1", Pendulum)

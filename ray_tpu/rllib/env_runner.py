@@ -1,0 +1,123 @@
+"""EnvRunner: the rollout worker.
+
+Reference: rllib/env/single_agent_env_runner.py (SingleAgentEnvRunner —
+steps a gymnasium vector env with the RLModule, emits episodes/batches)
+managed by EnvRunnerGroup (env_runner_group.py:71). Here one runner
+steps a batched-numpy VectorEnv with a *jitted* sampling policy; the
+Algorithm runs N of these as actors and broadcasts weights each
+iteration (reference: EnvRunnerGroup.sync_weights).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .env import VectorEnv, make_env
+from .sample_batch import (
+    ACTIONS, DONES, LOGP, NEXT_OBS, OBS, REWARDS, SampleBatch, VALUES,
+)
+
+
+class EnvRunner:
+    def __init__(self, config: dict, seed: int = 0):
+        self.config = dict(config)
+        self.num_envs = config.get("num_envs_per_env_runner", 8)
+        self.envs = VectorEnv(
+            lambda: make_env(config["env"], **config.get("env_config", {})),
+            self.num_envs,
+            seed=seed,
+        )
+        self._module = None
+        self._params = None
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = self.envs.reset(seed=seed)
+        self._sample_fn = None
+        self._epsilon = 1.0  # for value-based exploration
+        self._rng = np.random.default_rng(seed + 1)
+
+    # -- weights ------------------------------------------------------
+    def set_module(self, module) -> bool:
+        self._module = module
+        self._sample_fn = None
+        return True
+
+    def set_weights(self, params, epsilon: Optional[float] = None) -> bool:
+        self._params = jax.device_put(params)
+        if epsilon is not None:
+            self._epsilon = epsilon
+        return True
+
+    # -- rollout ------------------------------------------------------
+    def sample(self, num_steps: int) -> SampleBatch:
+        """Collect num_steps * num_envs transitions (policy-gradient
+        style: with logp + values when the module is actor-critic;
+        epsilon-greedy when it is a Q-module)."""
+        mod = self._module
+        if self._sample_fn is None:
+            if hasattr(mod, "sample_action"):
+                self._sample_fn = jax.jit(mod.sample_action)
+            else:
+                self._sample_fn = jax.jit(mod.best_action)
+        cols = {OBS: [], ACTIONS: [], REWARDS: [], DONES: [],
+                NEXT_OBS: []}
+        is_ac = hasattr(mod, "sample_action")
+        if is_ac:
+            cols[LOGP] = []
+            cols[VALUES] = []
+        for _ in range(num_steps):
+            obs = self._obs
+            if is_ac:
+                self._key, sub = jax.random.split(self._key)
+                action, logp, value = self._sample_fn(
+                    self._params, obs, sub)
+                action = np.asarray(action)
+                cols[LOGP].append(np.asarray(logp))
+                cols[VALUES].append(np.asarray(value))
+            else:
+                greedy = np.asarray(self._sample_fn(self._params, obs))
+                explore = self._rng.random(self.num_envs) < self._epsilon
+                randa = self._rng.integers(
+                    0, mod.act_dim, self.num_envs)
+                action = np.where(explore, randa, greedy)
+            act_env = action
+            if not is_ac or getattr(mod, "discrete", True):
+                act_env = np.asarray(action)
+            next_obs, rew, done = self.envs.step(act_env)
+            cols[OBS].append(obs)
+            cols[ACTIONS].append(action)
+            cols[REWARDS].append(rew)
+            cols[DONES].append(done)
+            cols[NEXT_OBS].append(next_obs)
+            self._obs = next_obs
+        # [T, B, ...] -> [T*B, ...] (time-major concat keeps per-env
+        # trajectories recoverable via reshape for GAE)
+        out = SampleBatch({
+            k: np.stack(v).reshape((-1,) + np.asarray(v[0]).shape[1:])
+            for k, v in cols.items()
+        })
+        out["t_b_shape"] = np.asarray([num_steps, self.num_envs])
+        return out
+
+    def episode_stats(self):
+        rets, lens = self.envs.pop_episode_stats()
+        return {"episode_returns": rets, "episode_lengths": lens}
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        """Greedy-policy mean episode return."""
+        env = VectorEnv(
+            lambda: make_env(
+                self.config["env"], **self.config.get("env_config", {})),
+            1,
+            seed=int(self._rng.integers(2**31)),
+        )
+        best = jax.jit(self._module.best_action)
+        total = []
+        obs = env.reset()
+        while len(total) < num_episodes:
+            a = np.asarray(best(self._params, obs))
+            obs, _r, _d = env.step(a)
+            rets, _ = env.pop_episode_stats()
+            total.extend(rets)
+        return float(np.mean(total[:num_episodes]))

@@ -1,0 +1,155 @@
+"""Learner + LearnerGroup: the update side.
+
+Reference: rllib/core/learner/learner.py:107 (Learner — owns params,
+optimizer, jitted-equivalent update), learner_group.py:100 (LearnerGroup
+— data-parallel learners with grad averaging; `update` :234).
+
+DDP here: each learner actor computes grads on its batch shard; the
+group averages the grad pytrees (host plane, small MLPs) and every
+learner applies the same averaged grads — bitwise-identical replicas
+without NCCL. On TPU the single-learner path is the common one: one
+jitted update over the chip's mesh does the heavy lifting.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import optax
+
+
+class Learner:
+    """Base learner: subclasses define build() extras and update()."""
+
+    def __init__(self, module, config: dict, seed: int = 0):
+        self.module = module
+        self.config = dict(config)
+        self.key = jax.random.PRNGKey(seed)
+        self.key, sub = jax.random.split(self.key)
+        self.params = module.init(sub)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.get("grad_clip", 10.0)),
+            optax.adam(config.get("lr", 3e-4)),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self._metrics: Dict[str, float] = {}
+
+    # -- weights ------------------------------------------------------
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> bool:
+        self.params = jax.device_put(params)
+        return True
+
+    def get_state(self) -> dict:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: dict) -> bool:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        return True
+
+    # -- update -------------------------------------------------------
+    def update(self, batch) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def compute_grads(self, batch) -> Any:
+        """DDP half-step: grads only (host-transferable pytree)."""
+        raise NotImplementedError
+
+    def apply_grads(self, grads) -> Dict[str, float]:
+        updates, self.opt_state = self.optimizer.update(
+            jax.device_put(grads), self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        return dict(self._metrics)
+
+
+def _tree_mean(trees: List[Any]):
+    return jax.tree_util.tree_map(
+        lambda *xs: np.mean(np.stack([np.asarray(x) for x in xs]), axis=0),
+        *trees,
+    )
+
+
+class LearnerGroup:
+    """num_learners == 0 -> one local in-process learner (the TPU path:
+    a single jitted update over the mesh). > 0 -> that many learner
+    actors doing grad-averaged DDP through the object store."""
+
+    def __init__(self, learner_cls, module, config: dict,
+                 num_learners: int = 0,
+                 learner_resources: Optional[dict] = None):
+        self.num_learners = num_learners
+        if num_learners == 0:
+            self._local = learner_cls(module, config)
+            self._actors = None
+        else:
+            import ray_tpu as ray
+
+            remote_cls = ray.remote(learner_cls)
+            if learner_resources:
+                remote_cls = remote_cls.options(**learner_resources)
+            self._local = None
+            self._actors = [
+                remote_cls.remote(module, config, seed=i)
+                for i in range(num_learners)
+            ]
+            # rank-0 weights win so replicas start identical
+            import ray_tpu as ray
+
+            state = ray.get(self._actors[0].get_state.remote())
+            ray.get([a.set_state.remote(state)
+                     for a in self._actors[1:]])
+
+    def update(self, batch) -> Dict[str, float]:
+        if self._local is not None:
+            return self._local.update(batch)
+        import ray_tpu as ray
+
+        shards = batch.split(len(self._actors))
+        grads = ray.get([
+            a.compute_grads.remote(s)
+            for a, s in zip(self._actors, shards)
+        ])
+        avg = _tree_mean(grads)
+        metrics = ray.get([
+            a.apply_grads.remote(avg) for a in self._actors
+        ])
+        return metrics[0]
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu as ray
+
+        return ray.get(self._actors[0].get_weights.remote())
+
+    def get_state(self) -> dict:
+        if self._local is not None:
+            return self._local.get_state()
+        import ray_tpu as ray
+
+        return ray.get(self._actors[0].get_state.remote())
+
+    def set_state(self, state: dict):
+        if self._local is not None:
+            self._local.set_state(state)
+        else:
+            import ray_tpu as ray
+
+            ray.get([a.set_state.remote(state) for a in self._actors])
+
+    def extra_call(self, method: str, *args):
+        """Algorithm-specific fan-out (e.g. DQN target sync)."""
+        if self._local is not None:
+            return [getattr(self._local, method)(*args)]
+        import ray_tpu as ray
+
+        return ray.get([
+            getattr(a, method).remote(*args) for a in self._actors
+        ])

@@ -1,0 +1,135 @@
+"""RLModule: the model abstraction, as pure-jax param pytrees.
+
+Reference: rllib/core/rl_module/rl_module.py (RLModule with
+forward_inference / forward_exploration / forward_train). Here a module
+is a (init, apply) pair over an explicit param pytree — jit/grad/pjit
+compose over it directly, matching the rest of the framework's model
+style (models/llama.py).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .spaces import Box, Discrete
+
+
+def _mlp_init(key, sizes: Sequence[int], out_scale: float = 0.01):
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = (
+            out_scale if i == len(sizes) - 2
+            else float(np.sqrt(2.0 / n_in))
+        )
+        params.append({
+            "w": jax.random.normal(sub, (n_in, n_out), jnp.float32) * scale,
+            "b": jnp.zeros((n_out,), jnp.float32),
+        })
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class ActorCriticModule:
+    """Separate policy + value MLPs; categorical head for Discrete
+    action spaces, squashed-gaussian head for Box."""
+
+    def __init__(self, obs_space: Box, action_space,
+                 hiddens: Sequence[int] = (64, 64)):
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.action_space = action_space
+        self.discrete = isinstance(action_space, Discrete)
+        self.act_dim = (
+            action_space.n if self.discrete
+            else int(np.prod(action_space.shape))
+        )
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key) -> dict:
+        k1, k2 = jax.random.split(key)
+        pi_out = self.act_dim if self.discrete else 2 * self.act_dim
+        return {
+            "pi": _mlp_init(k1, (self.obs_dim, *self.hiddens, pi_out)),
+            "vf": _mlp_init(k2, (self.obs_dim, *self.hiddens, 1),
+                            out_scale=1.0),
+        }
+
+    def value(self, params, obs) -> jax.Array:
+        return _mlp_apply(params["vf"], obs)[..., 0]
+
+    def pi_dist(self, params, obs) -> Tuple[jax.Array, jax.Array]:
+        """Returns distribution params: (logits, None) for discrete,
+        (mean, log_std) for continuous."""
+        out = _mlp_apply(params["pi"], obs)
+        if self.discrete:
+            return out, None
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, -5.0, 2.0)
+
+    def sample_action(self, params, obs, key):
+        """-> (action, logp, value); used on the rollout path (jitted
+        in the EnvRunner)."""
+        a, b = self.pi_dist(params, obs)
+        if self.discrete:
+            action = jax.random.categorical(key, a)
+            logp = jax.nn.log_softmax(a)[
+                jnp.arange(a.shape[0]), action]
+        else:
+            eps = jax.random.normal(key, a.shape)
+            action = a + jnp.exp(b) * eps
+            logp = self.logp(params, obs, action)
+        return action, logp, self.value(params, obs)
+
+    def logp(self, params, obs, actions) -> jax.Array:
+        a, b = self.pi_dist(params, obs)
+        if self.discrete:
+            return jax.nn.log_softmax(a)[
+                jnp.arange(a.shape[0]), actions.astype(jnp.int32)]
+        var = jnp.exp(2 * b)
+        return jnp.sum(
+            -0.5 * ((actions - a) ** 2 / var + 2 * b + jnp.log(2 * jnp.pi)),
+            axis=-1,
+        )
+
+    def entropy(self, params, obs) -> jax.Array:
+        a, b = self.pi_dist(params, obs)
+        if self.discrete:
+            logp = jax.nn.log_softmax(a)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return jnp.sum(b + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    def best_action(self, params, obs):
+        a, _ = self.pi_dist(params, obs)
+        return jnp.argmax(a, axis=-1) if self.discrete else a
+
+
+class QModule:
+    """Q-network for DQN-family algorithms (Discrete actions only)."""
+
+    def __init__(self, obs_space: Box, action_space: Discrete,
+                 hiddens: Sequence[int] = (64, 64)):
+        assert isinstance(action_space, Discrete), "DQN needs Discrete"
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.act_dim = action_space.n
+        self.hiddens = tuple(hiddens)
+
+    def init(self, key) -> dict:
+        return {"q": _mlp_init(
+            key, (self.obs_dim, *self.hiddens, self.act_dim),
+            out_scale=1.0)}
+
+    def q_values(self, params, obs) -> jax.Array:
+        return _mlp_apply(params["q"], obs)
+
+    def best_action(self, params, obs) -> jax.Array:
+        return jnp.argmax(self.q_values(params, obs), axis=-1)

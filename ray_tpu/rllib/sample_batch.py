@@ -1,0 +1,84 @@
+"""SampleBatch: the rollout data container.
+
+Reference: rllib/policy/sample_batch.py (SampleBatch — a dict of
+columns with concat/slice/shuffle helpers). Columns here are numpy
+arrays with a shared leading time/batch dim; learners move them to
+device once per update.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+NEXT_OBS = "next_obs"
+LOGP = "logp"
+VALUES = "values"
+ADVANTAGES = "advantages"
+TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @staticmethod
+    def concat(batches: List["SampleBatch"]) -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch({
+            k: np.concatenate([np.asarray(b[k]) for b in batches])
+            for k in keys
+        })
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        idx = rng.permutation(self.count)
+        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for i in range(0, n - size + 1, size):
+            yield SampleBatch(
+                {k: np.asarray(v)[i:i + size] for k, v in self.items()})
+
+    def split(self, parts: int) -> List["SampleBatch"]:
+        """Even shards for data-parallel learners.
+
+        Trajectory batches (carrying "t_b_shape" = [T, B]) shard along
+        the env axis B so each shard keeps whole trajectories (GAE and
+        other time-structured losses stay correct); flat batches shard
+        by interleaving rows (remainder dropped).
+        """
+        if "t_b_shape" in self and len(self["t_b_shape"]) >= 2:
+            T, B = (int(x) for x in np.asarray(self["t_b_shape"])[:2])
+            if B % parts == 0 and self.count == T * B:
+                b_shard = B // parts
+                out = []
+                for i in range(parts):
+                    cols = {}
+                    for k, v in self.items():
+                        if k == "t_b_shape":
+                            continue
+                        arr = np.asarray(v)
+                        tb = arr.reshape((T, B) + arr.shape[1:])
+                        sl = tb[:, i * b_shard:(i + 1) * b_shard]
+                        cols[k] = sl.reshape((T * b_shard,)
+                                             + arr.shape[1:])
+                    sb = SampleBatch(cols)
+                    sb["t_b_shape"] = np.asarray([T, b_shard])
+                    out.append(sb)
+                return out
+        n = (self.count // parts) * parts
+        return [
+            SampleBatch({k: np.asarray(v)[i::parts][: n // parts]
+                         for k, v in self.items() if k != "t_b_shape"})
+            for i in range(parts)
+        ]

@@ -1,0 +1,34 @@
+"""Minimal space types (the gymnasium surface the library needs).
+
+Reference: rllib uses gymnasium.spaces throughout; the image has no
+gymnasium, so Box/Discrete are defined here with the same fields.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass
+class Box:
+    low: float
+    high: float
+    shape: Tuple[int, ...]
+    dtype: type = np.float32
+
+    def sample(self, rng: np.random.Generator):
+        return rng.uniform(self.low, self.high, self.shape).astype(self.dtype)
+
+
+@dataclass
+class Discrete:
+    n: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return ()
+
+    def sample(self, rng: np.random.Generator):
+        return int(rng.integers(0, self.n))
