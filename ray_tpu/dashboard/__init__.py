@@ -1,0 +1,13 @@
+"""Dashboard: cluster observability web UI + HTTP API.
+
+Reference: python/ray/dashboard/ — head process (head.py, aiohttp
+http_server_head.py) with pluggable modules (node, job, state, metrics,
+log) and a React frontend. Here one aiohttp process serves a JSON API
+over the state/job/metrics subsystems plus a single-file HTML UI
+(no node/npm toolchain in the image; the API surface is what matters
+for parity — the reference's React client is a consumer of the same
+endpoints).
+"""
+from .head import DashboardHead
+
+__all__ = ["DashboardHead"]

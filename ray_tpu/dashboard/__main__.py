@@ -1,0 +1,3 @@
+from .head import main
+
+main()

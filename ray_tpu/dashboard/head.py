@@ -1,0 +1,302 @@
+"""Dashboard head: aiohttp server over the state/jobs/metrics APIs.
+
+Reference: python/ray/dashboard/head.py (DashboardHead) +
+http_server_head.py (aiohttp app), modules/node, modules/job/job_head.py
+(REST job endpoints), modules/metrics, modules/log. Runs inside any
+process connected to the cluster (a driver, or the standalone
+``python -m ray_tpu.dashboard`` entry).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+from typing import Any, Optional
+
+from .html import INDEX_HTML
+
+
+def _json(data: Any, status: int = 200):
+    from aiohttp import web
+
+    return web.json_response(
+        data, status=status, dumps=lambda d: json.dumps(d, default=str)
+    )
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._started = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "DashboardHead":
+        from .._private.rpc import EventLoopThread
+
+        loop = EventLoopThread.get().loop
+        fut = asyncio.run_coroutine_threadsafe(self._start(), loop)
+        fut.result(30)
+        return self
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        r = app.router
+        r.add_get("/", self._index)
+        r.add_get("/api/version", self._version)
+        r.add_get("/api/cluster_status", self._cluster_status)
+        r.add_get("/api/nodes", self._nodes)
+        r.add_get("/api/actors", self._actors)
+        r.add_get("/api/tasks", self._tasks)
+        r.add_get("/api/placement_groups", self._pgs)
+        r.add_get("/api/workers", self._workers)
+        r.add_get("/api/objects", self._objects)
+        r.add_get("/api/summary", self._summary)
+        r.add_get("/api/autoscaler", self._autoscaler)
+        r.add_get("/api/timeline", self._timeline)
+        r.add_get("/api/metrics", self._metrics)
+        r.add_get("/api/jobs", self._jobs_list)
+        r.add_post("/api/jobs", self._jobs_submit)
+        r.add_get("/api/jobs/{id}", self._job_info)
+        r.add_get("/api/jobs/{id}/logs", self._job_logs)
+        r.add_post("/api/jobs/{id}/stop", self._job_stop)
+        r.add_get("/api/logs/{node_id}", self._node_logs_list)
+        r.add_get("/api/logs/{node_id}/{name}", self._node_log_file)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        # port=0 -> resolve the bound port
+        for s in runner.sites:
+            srv = getattr(s, "_server", None)
+            if srv and srv.sockets:
+                self.port = srv.sockets[0].getsockname()[1]
+        self._runner = runner
+        self._started.set()
+
+    def stop(self):
+        if self._runner is None:
+            return
+        from .._private.rpc import EventLoopThread
+
+        loop = EventLoopThread.get().loop
+        asyncio.run_coroutine_threadsafe(
+            self._runner.cleanup(), loop).result(10)
+        self._runner = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- blocking state calls run off the event loop ------------------
+    async def _call(self, fn, *args, **kwargs):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: fn(*args, **kwargs))
+
+    # -- handlers -----------------------------------------------------
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
+    async def _version(self, request):
+        import ray_tpu
+
+        return _json({"version": getattr(ray_tpu, "__version__", "dev"),
+                      "framework": "ray_tpu"})
+
+    async def _cluster_status(self, request):
+        from .._private.core_worker import global_worker
+
+        return _json(await self._call(
+            global_worker().gcs.get_cluster_status))
+
+    async def _nodes(self, request):
+        from ..util import state
+
+        return _json(await self._call(state.list_nodes))
+
+    async def _actors(self, request):
+        from ..util import state
+
+        return _json(await self._call(state.list_actors))
+
+    async def _tasks(self, request):
+        from ..util import state
+
+        job_id = request.query.get("job_id")
+        limit = int(request.query.get("limit", 1000))
+        return _json(await self._call(state.list_tasks, job_id, limit))
+
+    async def _pgs(self, request):
+        from ..util import state
+
+        return _json(await self._call(state.list_placement_groups))
+
+    async def _workers(self, request):
+        from ..util import state
+
+        return _json(await self._call(state.list_workers))
+
+    async def _objects(self, request):
+        from ..util import state
+
+        limit = int(request.query.get("limit", 1000))
+        return _json(await self._call(state.list_objects, limit))
+
+    async def _summary(self, request):
+        from ..util import state
+
+        return _json({
+            "tasks": await self._call(state.summarize_tasks),
+            "actors": await self._call(state.summarize_actors),
+        })
+
+    async def _autoscaler(self, request):
+        from .._private.core_worker import global_worker
+
+        return _json(await self._call(
+            global_worker().gcs.get_autoscaler_state))
+
+    async def _timeline(self, request):
+        from .. import api
+
+        return _json(await self._call(api.timeline))
+
+    async def _metrics(self, request):
+        """Aggregated Prometheus text from every node's metrics agent
+        (reference: the dashboard scrapes per-node metrics agents)."""
+        import aiohttp
+        from aiohttp import web
+
+        from .._private.core_worker import global_worker
+
+        nodes = await self._call(global_worker().gcs.get_all_nodes)
+        chunks = []
+        async with aiohttp.ClientSession() as sess:
+            for n in nodes:
+                addr = n.get("metrics_address")
+                if not addr or not n.get("alive", True):
+                    continue
+                try:
+                    async with sess.get(
+                        f"http://{addr[0]}:{addr[1]}/metrics",
+                        timeout=aiohttp.ClientTimeout(total=3),
+                    ) as resp:
+                        text = await resp.text()
+                    chunks.append(
+                        f"# node {n['node_id']}\n{text}")
+                except Exception:
+                    continue
+        return web.Response(text="\n".join(chunks),
+                            content_type="text/plain")
+
+    # -- jobs ---------------------------------------------------------
+    def _job_client(self):
+        from ..jobs import JobSubmissionClient
+
+        return JobSubmissionClient()
+
+    async def _jobs_list(self, request):
+        return _json(await self._call(
+            lambda: self._job_client().list_jobs()))
+
+    async def _jobs_submit(self, request):
+        body = await request.json()
+        entrypoint = body.get("entrypoint")
+        if not entrypoint:
+            return _json({"error": "entrypoint required"}, status=400)
+
+        def submit():
+            return self._job_client().submit_job(
+                entrypoint=entrypoint,
+                submission_id=body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+            )
+
+        return _json({"submission_id": await self._call(submit)})
+
+    async def _job_info(self, request):
+        sid = request.match_info["id"]
+        try:
+            return _json(await self._call(
+                lambda: self._job_client().get_job_info(sid)))
+        except Exception as e:
+            return _json({"error": str(e)}, status=404)
+
+    async def _job_logs(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["id"]
+        try:
+            logs = await self._call(
+                lambda: self._job_client().get_job_logs(sid))
+            return web.Response(text=logs, content_type="text/plain")
+        except Exception as e:
+            return _json({"error": str(e)}, status=404)
+
+    async def _job_stop(self, request):
+        sid = request.match_info["id"]
+        try:
+            return _json({"stopped": await self._call(
+                lambda: self._job_client().stop_job(sid))})
+        except Exception as e:
+            return _json({"error": str(e)}, status=404)
+
+    # -- logs ---------------------------------------------------------
+    def _session_logs_dir(self) -> Optional[str]:
+        from .._private.core_worker import global_worker
+
+        w = global_worker()
+        session_dir = getattr(w, "session_dir", None)
+        if session_dir:
+            d = os.path.join(session_dir, "logs")
+            if os.path.isdir(d):
+                return d
+        return None
+
+    async def _node_logs_list(self, request):
+        d = self._session_logs_dir()
+        if d is None:
+            return _json([])
+        return _json(sorted(os.listdir(d)))
+
+    async def _node_log_file(self, request):
+        from aiohttp import web
+
+        name = os.path.basename(request.match_info["name"])
+        d = self._session_logs_dir()
+        path = os.path.join(d or "", name)
+        if d is None or not os.path.isfile(path):
+            return _json({"error": "not found"}, status=404)
+        tail = int(request.query.get("tail_bytes", 1 << 20))
+        with open(path, "rb") as f:
+            f.seek(max(0, os.path.getsize(path) - tail))
+            data = f.read()
+        return web.Response(text=data.decode(errors="replace"),
+                            content_type="text/plain")
+
+
+def main():
+    import argparse
+
+    import ray_tpu as ray
+
+    p = argparse.ArgumentParser("ray-tpu dashboard")
+    p.add_argument("--address", required=True, help="GCS host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    args = p.parse_args()
+    ray.init(address=args.address)
+    head = DashboardHead(args.host, args.port).start()
+    print(f"DASHBOARD_READY {head.url}", flush=True)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""The single-file dashboard UI.
+
+Reference: python/ray/dashboard/client/ is a 202-file React app; this
+vanilla-JS page consumes the same API surface (nodes/actors/tasks/jobs/
+placement groups/summary) with 2s polling — no build toolchain needed.
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 0; background: #f6f7f9; color: #1a202c; }
+  header { background: #1a2233; color: #fff; padding: 10px 20px; display: flex; align-items: baseline; gap: 16px; }
+  header h1 { font-size: 18px; margin: 0; }
+  header span { color: #9aa5b1; font-size: 12px; }
+  nav { display: flex; gap: 4px; padding: 8px 20px 0; }
+  nav button { border: 0; background: #e2e8f0; padding: 8px 14px; border-radius: 6px 6px 0 0; cursor: pointer; font-size: 13px; }
+  nav button.active { background: #fff; font-weight: 600; }
+  main { background: #fff; margin: 0 20px 20px; padding: 16px; border-radius: 0 6px 6px 6px; min-height: 300px; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 6px 10px; border-bottom: 1px solid #e2e8f0; }
+  th { color: #4a5568; font-weight: 600; background: #f8fafc; position: sticky; top: 0; }
+  .ALIVE, .RUNNING, .CREATED, .SUCCEEDED, .FINISHED { color: #15803d; font-weight: 600; }
+  .DEAD, .FAILED, .STOPPED { color: #b91c1c; font-weight: 600; }
+  .PENDING, .PENDING_CREATION, .RESTARTING, .RETRYING { color: #b45309; font-weight: 600; }
+  #summary { display: flex; gap: 16px; flex-wrap: wrap; margin-bottom: 12px; }
+  .tile { background: #f8fafc; border: 1px solid #e2e8f0; border-radius: 6px; padding: 10px 16px; min-width: 110px; }
+  .tile .v { font-size: 22px; font-weight: 700; }
+  .tile .k { font-size: 11px; color: #64748b; text-transform: uppercase; }
+</style>
+</head>
+<body>
+<header><h1>ray_tpu</h1><span id="status">connecting…</span></header>
+<nav id="tabs"></nav>
+<main>
+  <div id="summary"></div>
+  <div id="content">loading…</div>
+</main>
+<script>
+const TABS = {
+  nodes: {url: "/api/nodes", cols: ["node_id","state","is_head","address","resources_total","resources_available"]},
+  actors: {url: "/api/actors", cols: ["actor_id","state","name","class_name","node_id","restarts"]},
+  tasks: {url: "/api/tasks", cols: ["task_id","name","state","job_id","node_id"]},
+  jobs: {url: "/api/jobs", cols: ["submission_id","status","entrypoint","start_time","end_time"]},
+  placement_groups: {url: "/api/placement_groups", cols: ["placement_group_id","state","strategy","bundles"]},
+  autoscaler: {url: "/api/autoscaler", raw: true},
+};
+let active = "nodes";
+const tabsEl = document.getElementById("tabs");
+for (const name of Object.keys(TABS)) {
+  const b = document.createElement("button");
+  b.textContent = name.replace("_", " ");
+  b.onclick = () => { active = name; render(); refresh(); };
+  b.id = "tab-" + name;
+  tabsEl.appendChild(b);
+}
+function render() {
+  for (const name of Object.keys(TABS))
+    document.getElementById("tab-" + name).className = name === active ? "active" : "";
+}
+function cell(v) {
+  if (v === null || v === undefined) return "";
+  if (typeof v === "object") return JSON.stringify(v);
+  return String(v);
+}
+async function refresh() {
+  try {
+    const t = TABS[active];
+    const [data, summary, status] = await Promise.all([
+      fetch(t.url).then(r => r.json()),
+      fetch("/api/summary").then(r => r.json()),
+      fetch("/api/cluster_status").then(r => r.json()),
+    ]);
+    document.getElementById("status").textContent =
+      `uptime ${Math.round(status.uptime_s)}s · ${status.nodes.filter(n=>n.alive!==false).length} nodes · ${status.num_actors} actors`;
+    const sumEl = document.getElementById("summary");
+    sumEl.innerHTML = "";
+    const tiles = Object.assign(
+      {},
+      Object.fromEntries(Object.entries(summary.tasks || {}).map(([k,v]) => ["tasks " + k, v])),
+      Object.fromEntries(Object.entries(summary.actors || {}).map(([k,v]) => ["actors " + k, v])));
+    for (const [k, v] of Object.entries(tiles)) {
+      const d = document.createElement("div");
+      d.className = "tile";
+      d.innerHTML = `<div class="v">${v}</div><div class="k">${k}</div>`;
+      sumEl.appendChild(d);
+    }
+    const el = document.getElementById("content");
+    if (t.raw) { el.innerHTML = "<pre>" + JSON.stringify(data, null, 2) + "</pre>"; return; }
+    if (!Array.isArray(data) || !data.length) { el.textContent = "(empty)"; return; }
+    const cols = t.cols.filter(c => data.some(r => c in r));
+    let html = "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
+    for (const row of data.slice(0, 500)) {
+      html += "<tr>" + cols.map(c => {
+        const v = cell(row[c]);
+        const cls = (c === "state" || c === "status") ? ` class="${v}"` : "";
+        return `<td${cls}>${v}</td>`;
+      }).join("") + "</tr>";
+    }
+    el.innerHTML = html + "</table>";
+  } catch (e) {
+    document.getElementById("status").textContent = "error: " + e;
+  }
+}
+render();
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

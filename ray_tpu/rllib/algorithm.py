@@ -181,3 +181,4 @@ class Algorithm:
                     ray.kill(r)
                 except Exception:
                     pass
+        self.learner_group.shutdown()

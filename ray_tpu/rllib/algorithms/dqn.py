@@ -197,11 +197,10 @@ class DQN(Algorithm):
                        hiddens=self.config.hiddens)
 
     def _exploration_epsilon(self) -> Optional[float]:
+        # decay rides self._total_steps, which the base class already
+        # checkpoints/restores
         c = self.config
         frac = min(1.0, self._total_steps
                    / max(1, c.epsilon_decay_steps))
         return float(c.epsilon_initial
                      + frac * (c.epsilon_final - c.epsilon_initial))
-
-    def _algo_state(self) -> dict:
-        return {"total_steps": self._total_steps}

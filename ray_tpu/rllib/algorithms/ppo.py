@@ -141,10 +141,13 @@ class PPOLearner(Learner):
         mb_size = min(self.config.get("minibatch_size", 128), T * B)
         n_mb = max(1, (T * B) // mb_size)
         self.key, sub = jax.random.split(self.key)
+        # permute the FULL index range, then truncate: the remainder
+        # dropped each epoch is random, not systematically the
+        # rollout's final timesteps
         idx = jax.random.permutation(
-            sub, jnp.tile(jnp.arange(n_mb * mb_size), (epochs, 1)),
+            sub, jnp.tile(jnp.arange(T * B), (epochs, 1)),
             axis=1, independent=True,
-        ).reshape(epochs * n_mb, mb_size)
+        )[:, : n_mb * mb_size].reshape(epochs * n_mb, mb_size)
         dev_batch = {
             OBS: jnp.asarray(batch[OBS]).reshape(T, B, -1),
             ACTIONS: jnp.asarray(batch[ACTIONS]).reshape(

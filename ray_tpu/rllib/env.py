@@ -52,17 +52,22 @@ class VectorEnv:
     arbitrary single envs are wrapped with a Python loop fallback.
     """
 
-    def __init__(self, creator: Callable[[], Env], num_envs: int,
+    def __init__(self, creator: Callable[..., Env], num_envs: int,
                  seed: int = 0):
         probe = creator()
         self.observation_space = probe.observation_space
         self.action_space = probe.action_space
         self.num_envs = num_envs
+        self._batched = None
+        self._envs = None
         if isinstance(probe, _BatchedEnv):
-            self._batched = type(probe)(batch=num_envs)
-            self._envs = None
-        else:
-            self._batched = None
+            try:
+                # rebuild through the creator so constructor kwargs /
+                # env_config survive (only the batch width changes)
+                self._batched = creator(batch=num_envs)
+            except TypeError:
+                pass  # creator doesn't forward batch: loop fallback
+        if self._batched is None:
             self._envs = [probe] + [creator() for _ in range(num_envs - 1)]
         self._rng = np.random.default_rng(seed)
         self._ep_ret = np.zeros(num_envs, np.float64)

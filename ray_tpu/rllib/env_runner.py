@@ -24,8 +24,9 @@ class EnvRunner:
     def __init__(self, config: dict, seed: int = 0):
         self.config = dict(config)
         self.num_envs = config.get("num_envs_per_env_runner", 8)
+        env_config = config.get("env_config", {})
         self.envs = VectorEnv(
-            lambda: make_env(config["env"], **config.get("env_config", {})),
+            lambda **kw: make_env(config["env"], **{**env_config, **kw}),
             self.num_envs,
             seed=seed,
         )
@@ -106,9 +107,10 @@ class EnvRunner:
 
     def evaluate(self, num_episodes: int = 5) -> float:
         """Greedy-policy mean episode return."""
+        env_config = self.config.get("env_config", {})
         env = VectorEnv(
-            lambda: make_env(
-                self.config["env"], **self.config.get("env_config", {})),
+            lambda **kw: make_env(
+                self.config["env"], **{**env_config, **kw}),
             1,
             seed=int(self._rng.integers(2**31)),
         )

@@ -144,6 +144,17 @@ class LearnerGroup:
 
             ray.get([a.set_state.remote(state) for a in self._actors])
 
+    def shutdown(self):
+        if self._actors:
+            import ray_tpu as ray
+
+            for a in self._actors:
+                try:
+                    ray.kill(a)
+                except Exception:
+                    pass
+            self._actors = None
+
     def extra_call(self, method: str, *args):
         """Algorithm-specific fan-out (e.g. DQN target sync)."""
         if self._local is not None:

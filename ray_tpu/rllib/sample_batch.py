@@ -7,7 +7,7 @@ device once per update.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterator, List
+from typing import List
 
 import numpy as np
 
@@ -39,41 +39,35 @@ class SampleBatch(dict):
             for k in keys
         })
 
-    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
-        idx = rng.permutation(self.count)
-        return SampleBatch({k: np.asarray(v)[idx] for k, v in self.items()})
-
-    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
-        n = self.count
-        for i in range(0, n - size + 1, size):
-            yield SampleBatch(
-                {k: np.asarray(v)[i:i + size] for k, v in self.items()})
-
     def split(self, parts: int) -> List["SampleBatch"]:
-        """Even shards for data-parallel learners.
+        """Shards for data-parallel learners.
 
         Trajectory batches (carrying "t_b_shape" = [T, B]) shard along
         the env axis B so each shard keeps whole trajectories (GAE and
-        other time-structured losses stay correct); flat batches shard
-        by interleaving rows (remainder dropped).
+        other time-structured losses stay correct); shard widths may be
+        uneven (B need not divide by parts). Flat batches shard by
+        interleaving rows (remainder dropped).
         """
         if "t_b_shape" in self and len(self["t_b_shape"]) >= 2:
             T, B = (int(x) for x in np.asarray(self["t_b_shape"])[:2])
-            if B % parts == 0 and self.count == T * B:
-                b_shard = B // parts
+            if self.count == T * B:
+                if parts > B:
+                    raise ValueError(
+                        f"cannot split {B} envs across {parts} learners")
+                bounds = np.linspace(0, B, parts + 1).astype(int)
                 out = []
                 for i in range(parts):
+                    lo, hi = bounds[i], bounds[i + 1]
                     cols = {}
                     for k, v in self.items():
                         if k == "t_b_shape":
                             continue
                         arr = np.asarray(v)
                         tb = arr.reshape((T, B) + arr.shape[1:])
-                        sl = tb[:, i * b_shard:(i + 1) * b_shard]
-                        cols[k] = sl.reshape((T * b_shard,)
-                                             + arr.shape[1:])
+                        cols[k] = tb[:, lo:hi].reshape(
+                            (T * (hi - lo),) + arr.shape[1:])
                     sb = SampleBatch(cols)
-                    sb["t_b_shape"] = np.asarray([T, b_shard])
+                    sb["t_b_shape"] = np.asarray([T, hi - lo])
                     out.append(sb)
                 return out
         n = (self.count // parts) * parts
