@@ -1195,6 +1195,24 @@ class Raylet:
             "store": self.store.stats(),
         }
 
+    async def list_log_files(self):
+        """Log module source (reference: dashboard/modules/log — the
+        per-node agent serves its own log dir)."""
+        d = os.path.join(self.session_dir, "logs")
+        try:
+            return sorted(os.listdir(d))
+        except OSError:
+            return []
+
+    async def read_log_file(self, name: str, tail_bytes: int = 1 << 20):
+        d = os.path.join(self.session_dir, "logs")
+        path = os.path.join(d, os.path.basename(name))
+        if not os.path.isfile(path):
+            return None
+        with open(path, "rb") as f:
+            f.seek(max(0, os.path.getsize(path) - tail_bytes))
+            return f.read().decode(errors="replace")
+
     async def ping(self):
         return "pong"
 

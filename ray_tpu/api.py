@@ -28,10 +28,11 @@ _lock = threading.RLock()
 _node: Optional[_node_mod.Node] = None
 _worker: Optional[CoreWorker] = None
 _owns_node = False
+_client = None  # ClientWorker when connected via ray:// (client mode)
 
 
 def is_initialized() -> bool:
-    return _worker is not None
+    return _worker is not None or _client is not None
 
 
 def _parse_address(address) -> Tuple[str, int]:
@@ -50,12 +51,21 @@ def init(
     object_store_memory: Optional[int] = None,
     _system_config: Optional[dict] = None,
 ):
-    """Start a new local cluster (address=None) or connect to an existing
-    one ("host:port" of its GCS)."""
-    global _node, _worker, _owns_node
+    """Start a new local cluster (address=None), connect to an existing
+    one ("host:port" of its GCS), or connect as a remote client
+    ("ray://host:port" of a ClientServer — reference: util/client)."""
+    global _node, _worker, _owns_node, _client
     with _lock:
         if _worker is not None:
             return _worker
+        if _client is not None:
+            return _client
+        if isinstance(address, str) and address.startswith("ray://"):
+            from .util.client.worker import ClientWorker
+
+            host, port = _parse_address(address[len("ray://"):])
+            _client = ClientWorker(host, port, namespace=namespace)
+            return _client
         from ._private.config import get_config
 
         cfg = get_config()
@@ -93,8 +103,12 @@ def init(
 
 
 def shutdown():
-    global _node, _worker, _owns_node
+    global _node, _worker, _owns_node, _client
     with _lock:
+        if _client is not None:
+            _client.disconnect()
+            _client = None
+            return
         if _worker is not None:
             try:
                 _worker.gcs.mark_job_finished(job_id=_worker.job_id.hex())
@@ -113,6 +127,8 @@ def remote(*args, **options):
     python/ray/remote_function.py:41 / actor.py:1111)."""
 
     def decorate(obj):
+        if _client is not None:
+            return _client.remote(obj, **options)
         if isinstance(obj, type):
             return ActorClass(obj, **options)
         return RemoteFunction(obj, **options)
@@ -126,6 +142,8 @@ def remote(*args, **options):
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None):
+    if _client is not None:
+        return _client.get(refs, timeout=timeout)
     worker = global_worker()
     if isinstance(refs, ObjectRef):
         return worker.get_objects([refs], timeout)[0]
@@ -137,6 +155,8 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 def put(value: Any) -> ObjectRef:
     if isinstance(value, ObjectRef):
         raise TypeError("put() does not accept ObjectRefs")
+    if _client is not None:
+        return _client.put(value)
     return global_worker().put_object(value)
 
 
@@ -149,6 +169,9 @@ def wait(
 ):
     if isinstance(refs, ObjectRef):
         raise TypeError("wait() expects a list of ObjectRefs")
+    if _client is not None:
+        return _client.wait(list(refs), num_returns=num_returns,
+                            timeout=timeout)
     return global_worker().wait(
         list(refs), num_returns=num_returns, timeout=timeout,
         fetch_local=fetch_local,
@@ -156,6 +179,9 @@ def wait(
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):
+    if _client is not None:
+        _client.kill(actor, no_restart=no_restart)
+        return
     global_worker().kill_actor(actor.actor_id, no_restart=no_restart)
 
 
@@ -165,6 +191,8 @@ def cancel(ref: ObjectRef, *, force: bool = False):
 
 
 def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    if _client is not None:
+        return _client.get_actor(name, namespace)
     info = global_worker().gcs.get_named_actor(name=name, namespace=namespace)
     if info is None or info["state"] == "DEAD":
         raise ValueError(f"no live actor named {name!r}")
@@ -175,6 +203,8 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
 
 
 def nodes() -> List[dict]:
+    if _client is not None:
+        return _client.api("nodes")
     return global_worker().gcs.get_all_nodes()
 
 
@@ -201,6 +231,8 @@ def available_resources() -> dict:
 def timeline() -> List[dict]:
     """Chrome-trace-style task events (reference: ray timeline,
     scripts.py:2026)."""
+    if _client is not None:
+        return _client.api("timeline")
     events = global_worker().gcs.get_task_events()
     out = []
     for e in events:

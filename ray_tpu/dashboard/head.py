@@ -177,24 +177,28 @@ class DashboardHead:
         from .._private.core_worker import global_worker
 
         nodes = await self._call(global_worker().gcs.get_all_nodes)
-        chunks = []
+
+        async def scrape(sess, n):
+            addr = n["metrics_address"]
+            try:
+                async with sess.get(
+                    f"http://{addr[0]}:{addr[1]}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=3),
+                ) as resp:
+                    return f"# node {n['node_id']}\n{await resp.text()}"
+            except Exception:
+                return None
+
+        targets = [n for n in nodes
+                   if n.get("metrics_address") and n.get("alive", True)]
         async with aiohttp.ClientSession() as sess:
-            for n in nodes:
-                addr = n.get("metrics_address")
-                if not addr or not n.get("alive", True):
-                    continue
-                try:
-                    async with sess.get(
-                        f"http://{addr[0]}:{addr[1]}/metrics",
-                        timeout=aiohttp.ClientTimeout(total=3),
-                    ) as resp:
-                        text = await resp.text()
-                    chunks.append(
-                        f"# node {n['node_id']}\n{text}")
-                except Exception:
-                    continue
-        return web.Response(text="\n".join(chunks),
-                            content_type="text/plain")
+            # concurrent scrape: total latency is one slow node, not
+            # the sum over the fleet
+            chunks = await asyncio.gather(
+                *(scrape(sess, n) for n in targets))
+        return web.Response(
+            text="\n".join(c for c in chunks if c),
+            content_type="text/plain")
 
     # -- jobs ---------------------------------------------------------
     def _job_client(self):
@@ -248,38 +252,40 @@ class DashboardHead:
         except Exception as e:
             return _json({"error": str(e)}, status=404)
 
-    # -- logs ---------------------------------------------------------
-    def _session_logs_dir(self) -> Optional[str]:
+    # -- logs (routed to the target node's raylet, which serves its
+    #    own log dir — reference: per-node dashboard agent log module) --
+    async def _raylet_call(self, node_id: str, method: str, **kwargs):
         from .._private.core_worker import global_worker
 
         w = global_worker()
-        session_dir = getattr(w, "session_dir", None)
-        if session_dir:
-            d = os.path.join(session_dir, "logs")
-            if os.path.isdir(d):
-                return d
-        return None
+        node = next(
+            (n for n in await self._call(w.gcs.get_all_nodes)
+             if n["node_id"] == node_id and n.get("alive", True)),
+            None,
+        )
+        if node is None:
+            return None
+        return await w._pool.get(*node["address"]).call(
+            method, timeout=10.0, **kwargs)
 
     async def _node_logs_list(self, request):
-        d = self._session_logs_dir()
-        if d is None:
-            return _json([])
-        return _json(sorted(os.listdir(d)))
+        files = await self._raylet_call(
+            request.match_info["node_id"], "list_log_files")
+        if files is None:
+            return _json({"error": "unknown node"}, status=404)
+        return _json(files)
 
     async def _node_log_file(self, request):
         from aiohttp import web
 
-        name = os.path.basename(request.match_info["name"])
-        d = self._session_logs_dir()
-        path = os.path.join(d or "", name)
-        if d is None or not os.path.isfile(path):
+        text = await self._raylet_call(
+            request.match_info["node_id"], "read_log_file",
+            name=request.match_info["name"],
+            tail_bytes=int(request.query.get("tail_bytes", 1 << 20)),
+        )
+        if text is None:
             return _json({"error": "not found"}, status=404)
-        tail = int(request.query.get("tail_bytes", 1 << 20))
-        with open(path, "rb") as f:
-            f.seek(max(0, os.path.getsize(path) - tail))
-            data = f.read()
-        return web.Response(text=data.decode(errors="replace"),
-                            content_type="text/plain")
+        return web.Response(text=text, content_type="text/plain")
 
 
 def main():

@@ -60,10 +60,16 @@ function render() {
   for (const name of Object.keys(TABS))
     document.getElementById("tab-" + name).className = name === active ? "active" : "";
 }
+function esc(s) {
+  return s.replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;",
+    '"': "&quot;", "'": "&#39;"
+  })[c]);
+}
 function cell(v) {
   if (v === null || v === undefined) return "";
-  if (typeof v === "object") return JSON.stringify(v);
-  return String(v);
+  if (typeof v === "object") return esc(JSON.stringify(v));
+  return esc(String(v));
 }
 async function refresh() {
   try {
@@ -95,7 +101,11 @@ async function refresh() {
     for (const row of data.slice(0, 500)) {
       html += "<tr>" + cols.map(c => {
         const v = cell(row[c]);
-        const cls = (c === "state" || c === "status") ? ` class="${v}"` : "";
+        // class names come from a server-side state enum; still
+        // whitelist to keep attribute context injection-proof
+        const safe = /^[A-Z_]+$/.test(v) ? v : "";
+        const cls = (c === "state" || c === "status") && safe
+          ? ` class="${safe}"` : "";
         return `<td${cls}>${v}</td>`;
       }).join("") + "</tr>";
     }

@@ -72,6 +72,22 @@ def cmd_start(args):
 
     atexit.unregister(node.shutdown)
     pids = [p.pid for p in node._procs]
+    addr_str = f"{node.gcs_address[0]}:{node.gcs_address[1]}"
+    extras = []
+    if args.head and getattr(args, "dashboard_port", None):
+        extras.append(_spawn_service(
+            ["-m", "ray_tpu.dashboard", "--address", addr_str,
+             "--port", str(args.dashboard_port)],
+            node.session_dir, "dashboard", "DASHBOARD_READY"))
+        print(f"  dashboard:   http://127.0.0.1:{args.dashboard_port}")
+    if args.head and getattr(args, "ray_client_server_port", None):
+        extras.append(_spawn_service(
+            ["-m", "ray_tpu.util.client", "--address", addr_str,
+             "--port", str(args.ray_client_server_port)],
+            node.session_dir, "client_server", "CLIENT_SERVER_READY"))
+        print("  client:      ray_tpu.init(address="
+              f"\"ray://127.0.0.1:{args.ray_client_server_port}\")")
+    pids += extras
     info = {
         "gcs_address": list(node.gcs_address),
         "session_dir": node.session_dir,
@@ -93,6 +109,43 @@ def cmd_start(args):
                 time.sleep(1.0)
         except KeyboardInterrupt:
             _stop_pids(pids)
+
+
+def _spawn_service(py_args, session_dir, name, ready_marker,
+                   timeout=60.0) -> int:
+    """Detached helper process (dashboard / client server) with its
+    stdout captured in the session log dir; waits for the readiness
+    line so 'start' failing is loud, not silent."""
+    import subprocess
+    import sys
+
+    import select
+
+    log = open(os.path.join(session_dir, "logs", f"{name}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, *py_args],
+        stdout=subprocess.PIPE, stderr=log,
+        start_new_session=True,
+    )
+    deadline = time.time() + timeout
+    buf = b""
+    fd = proc.stdout.fileno()
+    os.set_blocking(fd, False)
+    while time.time() < deadline:
+        # poll-based wait: a child that hangs BEFORE printing anything
+        # must still trip the deadline (readline would block forever)
+        r, _, _ = select.select([fd], [], [], 0.5)
+        if r:
+            chunk = os.read(fd, 65536)
+            if chunk:
+                log.write(chunk)
+                buf += chunk
+                if ready_marker.encode() in buf:
+                    return proc.pid
+        if proc.poll() is not None:
+            raise RuntimeError(f"{name} exited rc={proc.returncode}")
+    proc.kill()
+    raise RuntimeError(f"{name} not ready in {timeout}s")
 
 
 def _alive(pid: int) -> bool:
@@ -235,6 +288,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--head", action="store_true")
     s.add_argument("--address", help="GCS address to join (worker nodes)")
     s.add_argument("--resources", help='JSON, e.g. \'{"CPU": 8}\'')
+    s.add_argument("--dashboard-port", type=int, default=None,
+                   help="serve the dashboard UI on this port (head only)")
+    s.add_argument("--ray-client-server-port", type=int, default=None,
+                   help="serve ray:// clients on this port (head only)")
     s.add_argument("--block", action="store_true",
                    help="stay attached; ctrl-c stops the node")
     s.set_defaults(fn=cmd_start)

@@ -1,0 +1,19 @@
+"""Ray Client: remote drivers over one socket (``ray://host:port``).
+
+Reference: python/ray/util/client/ (+ server/) — a gRPC proxy mode
+where a machine OUTSIDE the cluster network runs driver code; the
+server hosts per-client proxy state (object refs, actor handles,
+exported functions) and executes API calls on the client's behalf
+(worker.py:81 client Worker, server/server.py per-client servicer).
+
+Needed here for the same reason: a direct ``ray_tpu.init(address=...)``
+driver must share the head node's shm arena (local-only); ``ray://``
+lifts that requirement to one TCP connection.
+"""
+from .server import ClientServer
+from .worker import ClientWorker, ClientObjectRef, ClientActorHandle
+
+__all__ = [
+    "ClientServer", "ClientWorker", "ClientObjectRef",
+    "ClientActorHandle",
+]

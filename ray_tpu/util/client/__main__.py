@@ -1,0 +1,20 @@
+"""Standalone client server: ``python -m ray_tpu.util.client``.
+
+Reference: the client server the reference starts from `ray start --head
+--ray-client-server-port` (util/client/server/__main__ equivalent).
+"""
+import argparse
+import threading
+
+import ray_tpu as ray
+from .server import ClientServer
+
+p = argparse.ArgumentParser("ray-tpu client server")
+p.add_argument("--address", required=True, help="GCS host:port")
+p.add_argument("--host", default="0.0.0.0")
+p.add_argument("--port", type=int, default=10001)
+args = p.parse_args()
+ray.init(address=args.address)
+srv = ClientServer(args.host, args.port)
+print(f"CLIENT_SERVER_READY {srv.address[0]}:{srv.address[1]}", flush=True)
+threading.Event().wait()

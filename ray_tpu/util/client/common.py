@@ -1,0 +1,104 @@
+"""Wire helpers: pickling with object-ref indirection.
+
+Reference: python/ray/util/client/client_pickler.py — client pickles
+args with ClientObjectRef/ClientActorHandle reduced to id stubs; the
+server unpickles stubs back into real ObjectRefs/handles. Implemented
+with pickle's persistent_id/persistent_load hooks so refs nested
+anywhere in an argument tree round-trip without a manual walk.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Callable
+
+import cloudpickle
+
+
+def _is_client_local(obj) -> bool:
+    """True for classes/functions defined in modules that exist only on
+    the client machine (not stdlib, not installed packages): those must
+    pickle BY VALUE or the server fails with ModuleNotFoundError."""
+    import sys
+    import sysconfig
+
+    mod_name = getattr(obj, "__module__", "") or ""
+    if mod_name in ("builtins", "__main__") or \
+            mod_name.split(".")[0] in ("ray_tpu", "numpy", "jax"):
+        return mod_name == "__main__"
+    mod = sys.modules.get(mod_name)
+    f = getattr(mod, "__file__", None) if mod else None
+    if f is None:
+        return False  # builtin/extension module: importable everywhere
+    stdlib = sysconfig.get_paths()["stdlib"]
+    return not (f.startswith(stdlib) or "site-packages" in f
+                or "dist-packages" in f)
+
+
+class ClientPickler(cloudpickle.CloudPickler):
+    """Replaces client-side stubs with ("ref"|"actor", id) pids and
+    pickles client-local classes by value (an argument's CLASS is
+    normally stored as a module reference)."""
+
+    def persistent_id(self, obj):
+        from .worker import ClientActorHandle, ClientObjectRef
+
+        if isinstance(obj, ClientObjectRef):
+            return ("ref", obj.id)
+        if isinstance(obj, ClientActorHandle):
+            return ("actor", obj.actor_id)
+        return None
+
+    def reducer_override(self, obj):
+        if isinstance(obj, type) and _is_client_local(obj):
+            try:
+                return cloudpickle.cloudpickle._dynamic_class_reduce(obj)
+            except Exception:
+                pass
+        return super().reducer_override(obj)
+
+
+def client_dumps(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    ClientPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
+    return buf.getvalue()
+
+
+def dumps_definition(obj: Any) -> bytes:
+    """Pickle a function/class BY VALUE: the client's modules are not
+    importable on the cluster (the whole point of ray://), so
+    module-level definitions must ship their code, not a module path
+    (reference: client_pickler registers the driver's modules for
+    by-value pickling)."""
+    import sys
+
+    mod = sys.modules.get(getattr(obj, "__module__", ""), None)
+    name = getattr(mod, "__name__", "")
+    if mod is None or name in ("builtins", "__main__") or \
+            name.startswith("ray_tpu"):
+        return cloudpickle.dumps(obj)
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+    except Exception:
+        return cloudpickle.dumps(obj)
+    try:
+        return cloudpickle.dumps(obj)
+    finally:
+        try:
+            cloudpickle.unregister_pickle_by_value(mod)
+        except Exception:
+            pass
+
+
+class ServerUnpickler(pickle.Unpickler):
+    def __init__(self, data: bytes, resolve: Callable[[str, str], Any]):
+        super().__init__(io.BytesIO(data))
+        self._resolve = resolve
+
+    def persistent_load(self, pid):
+        kind, ident = pid
+        return self._resolve(kind, ident)
+
+
+def server_loads(data: bytes, resolve: Callable[[str, str], Any]) -> Any:
+    return ServerUnpickler(data, resolve).load()

@@ -1,0 +1,296 @@
+"""Client server: hosts per-client proxy state on the cluster side.
+
+Reference: python/ray/util/client/server/server.py (RayletServicer —
+per-client object/actor registries, function cache, data streaming) +
+proxier.py. Runs inside any cluster-connected process (typically the
+head node, started by `ray-tpu start --head --ray-client-server-port`).
+
+Every RPC executes through the PUBLIC driver API of this process (put/
+get/wait/remote) — the server is a consumer of the framework, not a
+backdoor, mirroring how the reference's specific server drives
+ray.* on behalf of the client.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+import uuid
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ..._private import serialization
+from ..._private.rpc import EventLoopThread, RpcServer
+from .common import server_loads
+
+
+_DYNAMIC_MODULE = "ray_tpu.util.client.__dynamic__"  # not importable
+
+
+def _mark_dynamic(obj) -> None:
+    """Detach a client-shipped definition from any module path.
+
+    The server process may coincidentally import a module with the same
+    name as the client's (e.g. both run the same script); cloudpickle
+    would then re-pickle the definition — and, for classes, every
+    METHOD — BY REFERENCE when shipping it to workers, and workers,
+    which lack the module, fail with ModuleNotFoundError. Pointing
+    __module__ at a non-importable name forces by-value pickling
+    everywhere downstream."""
+    import types
+
+    try:
+        obj.__module__ = _DYNAMIC_MODULE
+    except Exception:
+        pass
+    if isinstance(obj, type):
+        for attr in vars(obj).values():
+            fn = attr
+            if isinstance(attr, (staticmethod, classmethod)):
+                fn = attr.__func__
+            elif isinstance(attr, property):
+                for f in (attr.fget, attr.fset, attr.fdel):
+                    if isinstance(f, types.FunctionType):
+                        _mark_dynamic(f)
+                continue
+            if isinstance(fn, types.FunctionType):
+                try:
+                    fn.__module__ = _DYNAMIC_MODULE
+                except Exception:
+                    pass
+
+
+class _Session:
+    def __init__(self, namespace: str):
+        import time
+
+        self.namespace = namespace
+        self.refs: Dict[str, Any] = {}        # ref id hex -> ObjectRef
+        self.actors: Dict[str, Any] = {}      # actor id -> ActorHandle
+        self.funcs: Dict[str, Any] = {}       # func id -> RemoteFunction
+        self.actor_classes: Dict[str, Any] = {}
+        self.last_seen = time.time()
+
+
+class ClientServer:
+    # a session whose client hasn't been heard from (clients heartbeat
+    # every 15s) is reaped, releasing its pinned refs/handles
+    SESSION_TTL_S = 120.0
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001):
+        self._sessions: Dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self._server = RpcServer(host, port)
+        self._server.register(self)  # methods are already client_*-named
+        loop = EventLoopThread.get().loop
+        asyncio.run_coroutine_threadsafe(
+            self._server.start(), loop).result(15)
+        self.address = self._server.address
+        self._reaper = asyncio.run_coroutine_threadsafe(
+            self._reap_loop(), loop)
+
+    def stop(self):
+        loop = EventLoopThread.get().loop
+        self._reaper.cancel()
+        asyncio.run_coroutine_threadsafe(
+            self._server.stop(), loop).result(10)
+
+    async def _reap_loop(self):
+        import time
+
+        while True:
+            await asyncio.sleep(self.SESSION_TTL_S / 4)
+            cutoff = time.time() - self.SESSION_TTL_S
+            with self._lock:
+                dead = [sid for sid, s in self._sessions.items()
+                        if s.last_seen < cutoff]
+                for sid in dead:
+                    self._sessions.pop(sid, None)
+
+    # -- helpers -------------------------------------------------------
+    def _session(self, session_id: str) -> _Session:
+        import time
+
+        s = self._sessions.get(session_id)
+        if s is None:
+            raise KeyError(f"unknown client session {session_id}")
+        s.last_seen = time.time()
+        return s
+
+    def _resolve(self, sess: _Session, kind: str, ident: str):
+        if kind == "ref":
+            return sess.refs[ident]
+        if kind == "actor":
+            return sess.actors[ident]
+        raise KeyError(kind)
+
+    def _load_args(self, sess: _Session, blob: bytes):
+        args, kwargs = server_loads(
+            blob, lambda k, i: self._resolve(sess, k, i))
+        return args, kwargs
+
+    def _track(self, sess: _Session, refs) -> list:
+        out = []
+        for r in refs if isinstance(refs, (list, tuple)) else [refs]:
+            sess.refs[r.id.hex()] = r
+            out.append(r.id.hex())
+        return out
+
+    # -- RPC surface (async handlers on the shared loop; blocking API
+    #    calls hop to a thread so the loop never stalls) ---------------
+    async def _in_thread(self, fn, *args, **kw):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, lambda: fn(*args, **kw))
+
+    async def client_connect(self, namespace: str = "") -> dict:
+        session_id = uuid.uuid4().hex
+        with self._lock:
+            self._sessions[session_id] = _Session(namespace)
+        return {"session_id": session_id}
+
+    async def client_disconnect(self, session_id: str) -> bool:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+        return True
+
+    async def client_put(self, session_id: str, payload: bytes) -> str:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        value = serialization.loads(payload)
+        ref = await self._in_thread(ray.put, value)
+        return self._track(sess, ref)[0]
+
+    async def client_get(self, session_id: str, ref_ids: list,
+                         get_timeout: Optional[float] = None) -> bytes:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        refs = [sess.refs[i] for i in ref_ids]
+        values = await self._in_thread(
+            ray.get, refs, timeout=get_timeout)
+        return serialization.dumps(values)
+
+    async def client_wait(self, session_id: str, ref_ids: list,
+                          num_returns: int = 1,
+                          wait_timeout: Optional[float] = None) -> dict:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        refs = [sess.refs[i] for i in ref_ids]
+        ready, pending = await self._in_thread(
+            ray.wait, refs, num_returns=num_returns,
+            timeout=wait_timeout)
+        return {"ready": [r.id.hex() for r in ready],
+                "pending": [r.id.hex() for r in pending]}
+
+    async def client_release(self, session_id: str, ref_ids: list) -> bool:
+        sess = self._session(session_id)
+        for i in ref_ids:
+            sess.refs.pop(i, None)
+        return True
+
+    async def client_register_function(self, session_id: str,
+                                       func_id: str, blob: bytes,
+                                       options: dict) -> bool:
+        """Function shipped once per session (reference: the client's
+        function cache keyed by id)."""
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        fn = cloudpickle.loads(blob)
+        _mark_dynamic(fn)
+        sess.funcs[func_id] = ray.remote(fn).options(**options) \
+            if options else ray.remote(fn)
+        return True
+
+    async def client_task(self, session_id: str, func_id: str,
+                          args_blob: bytes,
+                          options: Optional[dict] = None) -> list:
+        sess = self._session(session_id)
+        fn = sess.funcs[func_id]
+        if options:
+            fn = fn.options(**options)
+        args, kwargs = self._load_args(sess, args_blob)
+        refs = await self._in_thread(fn.remote, *args, **kwargs)
+        return self._track(sess, refs)
+
+    async def client_register_actor_class(self, session_id: str,
+                                          class_id: str, blob: bytes,
+                                          options: dict) -> bool:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        cls = cloudpickle.loads(blob)
+        _mark_dynamic(cls)
+        remote_cls = ray.remote(cls)
+        if options:
+            remote_cls = remote_cls.options(**options)
+        sess.actor_classes[class_id] = remote_cls
+        return True
+
+    async def client_create_actor(self, session_id: str, class_id: str,
+                                  args_blob: bytes,
+                                  options: Optional[dict] = None) -> dict:
+        sess = self._session(session_id)
+        cls = sess.actor_classes[class_id]
+        if options:
+            cls = cls.options(**options)
+        args, kwargs = self._load_args(sess, args_blob)
+        handle = await self._in_thread(cls.remote, *args, **kwargs)
+        sess.actors[handle.actor_id] = handle
+        return {"actor_id": handle.actor_id,
+                "methods": sorted(handle._methods)
+                if hasattr(handle, "_methods") else []}
+
+    async def client_actor_task(self, session_id: str, actor_id: str,
+                                method_name: str, args_blob: bytes,
+                                num_returns: Optional[int] = None) -> list:
+        sess = self._session(session_id)
+        handle = sess.actors[actor_id]
+        args, kwargs = self._load_args(sess, args_blob)
+        m = getattr(handle, method_name)
+        if num_returns is not None:
+            m = m.options(num_returns=num_returns)
+        refs = await self._in_thread(m.remote, *args, **kwargs)
+        return self._track(sess, refs)
+
+    async def client_get_actor(self, session_id: str, name: str,
+                               namespace: str = "") -> dict:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        handle = await self._in_thread(
+            ray.get_actor, name, namespace or sess.namespace)
+        sess.actors[handle.actor_id] = handle
+        return {"actor_id": handle.actor_id}
+
+    async def client_kill_actor(self, session_id: str, actor_id: str,
+                                no_restart: bool = True) -> bool:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        handle = sess.actors[actor_id]
+        await self._in_thread(ray.kill, handle, no_restart=no_restart)
+        return True
+
+    async def client_api(self, session_id: str, api_method: str) -> Any:
+        """Read-only cluster info passthrough."""
+        import ray_tpu as ray
+
+        self._session(session_id)
+        allowed = {
+            "nodes": ray.nodes,
+            "cluster_resources": ray.cluster_resources,
+            "available_resources": ray.available_resources,
+            "timeline": ray.timeline,
+        }
+        return await self._in_thread(allowed[api_method])
+
+    async def client_ping(self, session_id: str = "") -> str:
+        if session_id:
+            try:
+                self._session(session_id)  # refreshes last_seen
+            except KeyError:
+                pass
+        return "pong"

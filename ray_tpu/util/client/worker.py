@@ -1,0 +1,279 @@
+"""Client-side worker: the ``ray://`` driver surface.
+
+Reference: python/ray/util/client/worker.py:81 (Worker — owns the gRPC
+channel, mirrors put/get/wait/remote/actor calls through the server)
+and api.py (ClientAPI). Stub classes here mirror the real
+RemoteFunction/ActorClass/ActorHandle surface closely enough that
+driver scripts run unchanged against either mode.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import cloudpickle
+
+from ..._private import serialization
+from ..._private.rpc import RpcClient
+from .common import client_dumps, dumps_definition
+
+
+class ClientObjectRef:
+    __slots__ = ("id", "_worker")
+
+    def __init__(self, id_hex: str, worker: "ClientWorker"):
+        self.id = id_hex
+        self._worker = worker
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.id[:16]})"
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return (isinstance(other, ClientObjectRef)
+                and other.id == self.id)
+
+    def __reduce__(self):
+        raise TypeError(
+            "ClientObjectRef cannot be pickled outside client calls")
+
+    def __del__(self):
+        w = self._worker
+        if w is not None and not getattr(w, "_closed", True):
+            try:
+                w._mark_released(self.id)
+            except Exception:
+                pass
+
+
+class ClientRemoteMethod:
+    def __init__(self, handle: "ClientActorHandle", name: str):
+        self._handle = handle
+        self._name = name
+        self._num_returns: Optional[int] = None
+
+    def options(self, num_returns: Optional[int] = None):
+        m = ClientRemoteMethod(self._handle, self._name)
+        m._num_returns = num_returns
+        return m
+
+    def remote(self, *args, **kwargs):
+        w = self._handle._worker
+        ids = w._call(
+            "client_actor_task",
+            actor_id=self._handle.actor_id,
+            method_name=self._name,
+            args_blob=client_dumps((args, kwargs)),
+            num_returns=self._num_returns,
+        )
+        refs = [ClientObjectRef(i, w) for i in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class ClientActorHandle:
+    def __init__(self, actor_id: str, worker: "ClientWorker"):
+        self.actor_id = actor_id
+        self._worker = worker
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ClientRemoteMethod(self, name)
+
+    def __repr__(self):
+        return f"ClientActorHandle({self.actor_id[:16]})"
+
+
+class ClientRemoteFunction:
+    """The function body + base options ship once per session
+    (reference: the client function cache); per-call .options()
+    overrides ride each task RPC."""
+
+    def __init__(self, fn, worker: "ClientWorker", options: dict):
+        self._fn = fn
+        self._worker = worker
+        self._base_options = dict(options)
+        self._func_id = f"f-{uuid.uuid4().hex[:12]}"
+        self._registered = False
+        self._call_options: Optional[dict] = None
+
+    def options(self, **overrides) -> "ClientRemoteFunction":
+        out = ClientRemoteFunction.__new__(ClientRemoteFunction)
+        out.__dict__.update(self.__dict__)
+        out._call_options = overrides
+        return out
+
+    def remote(self, *args, **kwargs):
+        w = self._worker
+        if not self._registered:
+            w._call(
+                "client_register_function",
+                func_id=self._func_id,
+                blob=dumps_definition(self._fn),
+                options=self._base_options,
+            )
+            self._registered = True
+        ids = w._call(
+            "client_task",
+            func_id=self._func_id,
+            args_blob=client_dumps((args, kwargs)),
+            options=self._call_options,
+        )
+        refs = [ClientObjectRef(i, w) for i in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class ClientActorClass:
+    def __init__(self, cls, worker: "ClientWorker", options: dict):
+        self._cls = cls
+        self._worker = worker
+        self._options = dict(options)
+        self._class_id = f"c-{uuid.uuid4().hex[:12]}"
+        self._registered = False
+        self._call_options: Optional[dict] = None
+
+    def options(self, **overrides) -> "ClientActorClass":
+        out = ClientActorClass.__new__(ClientActorClass)
+        out.__dict__.update(self.__dict__)
+        out._call_options = overrides
+        return out
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        w = self._worker
+        if not self._registered:
+            w._call(
+                "client_register_actor_class",
+                class_id=self._class_id,
+                blob=dumps_definition(self._cls),
+                options=self._options,
+            )
+            self._registered = True
+        info = w._call(
+            "client_create_actor",
+            class_id=self._class_id,
+            args_blob=client_dumps((args, kwargs)),
+            options=self._call_options,
+        )
+        return ClientActorHandle(info["actor_id"], w)
+
+
+class ClientWorker:
+    """One connection to a ClientServer; the client-mode 'global
+    worker'."""
+
+    def __init__(self, host: str, port: int, namespace: str = ""):
+        self._client = RpcClient(host, port)
+        self._lock = threading.Lock()
+        self._released: List[str] = []
+        self._closed = False
+        res = self._call("client_connect", _no_session=True,
+                         namespace=namespace)
+        self.session_id = res["session_id"]
+        self.namespace = namespace
+        # liveness heartbeat: lets the server reap sessions whose client
+        # died without disconnect() (reference: client keepalive stream)
+        self._hb = threading.Thread(target=self._heartbeat, daemon=True)
+        self._hb.start()
+
+    def _heartbeat(self):
+        while not self._closed:
+            time.sleep(15.0)
+            if self._closed:
+                return
+            try:
+                self._client.call_sync(
+                    "client_ping", timeout=30.0,
+                    session_id=self.session_id)
+            except Exception:
+                pass
+
+    # Mutating ops must not be replayed after a mid-call connection
+    # drop (same convention as core_worker's push paths).
+    _NON_IDEMPOTENT = frozenset({
+        "client_put", "client_task", "client_actor_task",
+        "client_create_actor",
+    })
+    # Ops that legitimately block as long as the cluster needs.
+    _UNTIMED = frozenset({"client_get", "client_wait"})
+
+    def _call(self, method: str, _no_session: bool = False, **kwargs):
+        if not _no_session:
+            kwargs["session_id"] = self.session_id
+        self._flush_released()
+        return self._client.call_sync(
+            method,
+            timeout=None if method in self._UNTIMED else 300.0,
+            idempotent=method not in self._NON_IDEMPOTENT,
+            **kwargs,
+        )
+
+    # -- ref lifetime -------------------------------------------------
+    def _mark_released(self, ref_id: str):
+        with self._lock:
+            self._released.append(ref_id)
+
+    def _flush_released(self):
+        with self._lock:
+            if not self._released:
+                return
+            batch, self._released = self._released, []
+        try:
+            self._client.call_sync(
+                "client_release", timeout=60.0,
+                session_id=self.session_id, ref_ids=batch)
+        except Exception:
+            pass
+
+    # -- API surface --------------------------------------------------
+    def put(self, value: Any) -> ClientObjectRef:
+        rid = self._call("client_put",
+                         payload=serialization.dumps(value))
+        return ClientObjectRef(rid, self)
+
+    def get(self, refs: Union[ClientObjectRef, Sequence[ClientObjectRef]],
+            timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        lst = [refs] if single else list(refs)
+        payload = self._call("client_get",
+                             ref_ids=[r.id for r in lst],
+                             get_timeout=timeout)
+        values = serialization.loads(payload)
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ClientObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        by_id = {r.id: r for r in refs}
+        res = self._call("client_wait", ref_ids=list(by_id),
+                         num_returns=num_returns, wait_timeout=timeout)
+        return ([by_id[i] for i in res["ready"]],
+                [by_id[i] for i in res["pending"]])
+
+    def remote(self, obj, **options):
+        if isinstance(obj, type):
+            return ClientActorClass(obj, self, options)
+        return ClientRemoteFunction(obj, self, options)
+
+    def get_actor(self, name: str, namespace: str = ""
+                  ) -> ClientActorHandle:
+        info = self._call("client_get_actor", name=name,
+                          namespace=namespace)
+        return ClientActorHandle(info["actor_id"], self)
+
+    def kill(self, actor: ClientActorHandle, no_restart: bool = True):
+        self._call("client_kill_actor", actor_id=actor.actor_id,
+                   no_restart=no_restart)
+
+    def api(self, api_method: str):
+        return self._call("client_api", api_method=api_method)
+
+    def disconnect(self):
+        self._closed = True
+        try:
+            self._call("client_disconnect")
+        except Exception:
+            pass
+        self._client.close_sync()

@@ -76,6 +76,19 @@ def test_tasks_and_summary(dash):
     assert summary["tasks"].get("FINISHED", 0) >= 3
 
 
+def test_node_logs(dash):
+    nid = _get(dash.url + "/api/nodes")[0]["node_id"]
+    files = _get(dash.url + f"/api/logs/{nid}")
+    assert any(f.startswith("worker-") or f == "gcs.log" for f in files)
+    body = _get(dash.url + f"/api/logs/{nid}/{files[0]}")
+    assert isinstance(body, str)
+    # unknown node 404s instead of leaking the head's logs
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dash.url + "/api/logs/deadbeef")
+
+
 def test_metrics_scrape(dash):
     text = _get(dash.url + "/api/metrics")
     assert "# node " in text
