@@ -402,6 +402,8 @@ class CoreWorker:
         loop.spawn(self._flush_task_events_loop())
         loop.spawn(self._actor_event_loop())
         loop.spawn(self._metrics_flush_loop())
+        if self.mode == "driver" and self._cfg.log_to_driver:
+            loop.spawn(self._log_stream_loop())
 
     def shutdown(self):
         self._exit.set()
@@ -1083,6 +1085,11 @@ class CoreWorker:
             spec["tensor_transport"] = tensor_transport
         if runtime_env:
             spec["runtime_env"] = runtime_env
+        from ..util import tracing as _tracing
+
+        trace_ctx = _tracing.context_for_spec()
+        if trace_ctx:
+            spec["trace_ctx"] = trace_ctx
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
@@ -1406,6 +1413,11 @@ class CoreWorker:
         }
         if tensor_transport:
             spec["tensor_transport"] = tensor_transport
+        from ..util import tracing as _tracing
+
+        trace_ctx = _tracing.context_for_spec()
+        if trace_ctx:
+            spec["trace_ctx"] = trace_ctx
         for r in arg_refs:
             self._retain_ref(r.id, r.owner_address)
         with self._records_lock:
@@ -1524,7 +1536,14 @@ class CoreWorker:
             func = self._load_function(spec)
             args = [self._unpack_arg(a) for a in spec["args"]]
             kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
-            result = func(*args, **kwargs)
+            if spec.get("trace_ctx"):
+                from ..util import tracing
+
+                with tracing.span(spec.get("name", "task"), worker=self,
+                                  spec=spec):
+                    result = func(*args, **kwargs)
+            else:
+                result = func(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — shipped to the owner
             tb = traceback.format_exc()
             err = serialization.dumps(
@@ -1779,7 +1798,14 @@ class CoreWorker:
         args = [self._unpack_arg(a) for a in spec["args"]]
         kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
         try:
-            result = method(*args, **kwargs)
+            if spec.get("trace_ctx"):
+                from ..util import tracing
+
+                with tracing.span(spec.get("name", "actor_task"),
+                                  worker=self, spec=spec):
+                    result = method(*args, **kwargs)
+            else:
+                result = method(*args, **kwargs)
         except Exception as e:  # noqa: BLE001
             return self._actor_error_reply(spec, e)
         return {
@@ -2176,6 +2202,37 @@ class CoreWorker:
                     sub = self._actor_subs.get(msg.get("actor_id"))
                     if sub is not None:
                         sub.on_actor_event(msg)
+            except Exception:
+                await asyncio.sleep(0.5)
+
+    async def _log_stream_loop(self):
+        """Echo worker stdout/stderr to the driver's terminal with
+        (pid=..., node=...) prefixes (reference: worker.py's
+        print_logs fed by the log monitor via GCS pubsub)."""
+        import sys
+
+        sub_id = f"logs-{self.worker_id}"
+        subscribed = False
+        while not self._exit.is_set():
+            try:
+                if not subscribed:
+                    await self.gcs.aio.call(
+                        "subscribe", sub_id=sub_id, channels=["LOGS"]
+                    )
+                    subscribed = True
+                msgs = await self.gcs.aio.call(
+                    "poll", sub_id=sub_id, timeout_s=10.0, timeout=15.0
+                )
+                if msgs is None:
+                    subscribed = False
+                    continue
+                for _channel, msg in msgs:
+                    for entry in msg.get("entries", ()):
+                        prefix = (f"(pid={entry['pid']}, "
+                                  f"node={entry['node_id'][:8]})")
+                        for line in entry["lines"]:
+                            print(f"{prefix} {line}",
+                                  file=sys.stderr, flush=True)
             except Exception:
                 await asyncio.sleep(0.5)
 

@@ -56,18 +56,51 @@ def detect_node_resources() -> Tuple[Dict[str, float], Dict[str, str]]:
         resources["memory"] = float(psutil.virtual_memory().total)
     except Exception:
         pass
-    # TPU detection: env-driven (set by the TPU VM runtime / GKE), mirroring
-    # reference tpu.py:15-41 without probing libtpu from the daemon.
+    # TPU detection, in priority order (reference tpu.py:15-41):
+    #  1. env vars set by the TPU VM runtime / GKE injector
+    #  2. /dev/accel* device files (TPU VM without env plumbing)
+    #  3. GCE metadata server (opt-in: RAY_TPU_GCE_METADATA=1 — a
+    #     non-GCE host would pay a connect timeout per start otherwise)
     chips = os.environ.get("TPU_CHIPS", "")
     accel_type = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if not chips:
+        try:
+            import glob
+
+            n = len(glob.glob("/dev/accel*"))
+            if n:
+                chips = str(n)
+        except Exception:
+            pass
+    if not accel_type and os.environ.get("RAY_TPU_GCE_METADATA") == "1":
+        accel_type = _gce_metadata("instance/attributes/accelerator-type")
     if chips:
         resources["TPU"] = float(chips)
         labels["tpu-accelerator-type"] = accel_type or "unknown"
         labels["tpu-slice-name"] = os.environ.get("TPU_NAME", "local-slice")
         labels["tpu-worker-id"] = os.environ.get("TPU_WORKER_ID", "0")
+        topology = os.environ.get("TPU_TOPOLOGY", "")
+        if topology:
+            labels["tpu-topology"] = topology
         if accel_type:
             resources[f"TPU-{accel_type}"] = float(chips)
     return resources, labels
+
+
+def _gce_metadata(path: str) -> str:
+    """GKE/GCE metadata lookup (reference: tpu.py GKE + GCE metadata
+    paths); short timeout, best-effort."""
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://metadata.google.internal/computeMetadata/v1/{path}",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=0.5) as r:
+            return r.read().decode()
+    except Exception:
+        return ""
 
 
 class _Lease:
@@ -88,10 +121,10 @@ class _Lease:
 
 class _WorkerHandle:
     __slots__ = ("worker_id", "proc", "address", "registered", "alive",
-                 "reserved", "tpu", "env_key", "idle_since")
+                 "reserved", "tpu", "env_key", "idle_since", "chips")
 
     def __init__(self, worker_id: str, proc: subprocess.Popen,
-                 tpu: bool = False, env_key=None):
+                 tpu: int = 0, env_key=None, chips=()):
         self.worker_id = worker_id
         self.proc = proc
         self.address: Optional[Tuple[str, int]] = None
@@ -100,7 +133,13 @@ class _WorkerHandle:
         # True while a pending lease claimed this (possibly still starting)
         # worker; register_worker must not put it in the idle pool.
         self.reserved = False
+        # chip COUNT this worker owns (0 = CPU worker); pools are keyed
+        # by it so a 2-chip lease never reuses a 4-chip worker
         self.tpu = tpu
+        # the specific chip ids pinned via TPU_VISIBLE_CHIPS (reference:
+        # accelerators/tpu.py:32-41 — chips on one host are partitioned
+        # per worker process, libtpu being single-owner per chip)
+        self.chips = tuple(chips)
         # runtime-env pool key (None = vanilla worker); reference:
         # worker_pool.h runtime-env-keyed pools
         self.env_key = env_key
@@ -260,6 +299,8 @@ class Raylet:
         )
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._lease_grant_loop()))
+        if self._cfg.log_to_driver:
+            self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         self._bg.append(asyncio.ensure_future(self._worker_watcher_loop()))
         if self._cfg.memory_usage_threshold > 0:
             self._bg.append(
@@ -375,7 +416,32 @@ class Raylet:
             _json.dumps(runtime_env, sort_keys=True).encode()
         ).hexdigest()[:12]
 
-    def _spawn_worker(self, tpu: bool = False,
+    def _free_chip_ids(self):
+        held = set()
+        for h in self._workers.values():
+            held.update(h.chips)
+        return [c for c in range(int(self.total.get("TPU", 0)))
+                if c not in held]
+
+    def _evict_idle_tpu_workers(self):
+        """Terminate idle chip-holding workers so their chips can be
+        re-pinned (they keep libtpu ownership while pooled)."""
+        for (tpu, env_key), pool in list(self._idle_workers.items()):
+            if not tpu:
+                continue
+            while pool:
+                wid = pool.popleft()
+                h = self._workers.get(wid)
+                if h is None or h.reserved:
+                    continue
+                h.alive = False
+                try:
+                    h.proc.terminate()
+                except Exception:
+                    pass
+                self._workers.pop(wid, None)
+
+    def _spawn_worker(self, tpu: int = 0,
                       runtime_env: Optional[dict] = None) -> _WorkerHandle:
         worker_id = uuid.uuid4().hex
         log = open(
@@ -387,6 +453,7 @@ class Raylet:
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        chips: tuple = ()
         if not tpu:
             # CPU worker: disable the TPU runtime hook (faster startup; the
             # chip stays claimable by TPU workers / the driver). JAX_PLATFORMS
@@ -394,6 +461,36 @@ class Raylet:
             # jax at the backend we just disabled.
             env["PALLAS_AXON_POOL_IPS"] = ""
             env["JAX_PLATFORMS"] = "cpu"
+        else:
+            # Partition the host's chips: a k-chip lease gets a worker
+            # that sees exactly k chips (reference: TPU_VISIBLE_CHIPS
+            # isolation, accelerators/tpu.py:32-41). Only set when a
+            # proper subset is requested — whole-host workers keep the
+            # runtime's own numbering. IDLE workers keep libtpu
+            # ownership of their chips, so when free ids don't cover
+            # the request, evict idle TPU workers first; an unpinned
+            # worker next to pinned ones would fight over devices.
+            total_chips = int(self.total.get("TPU", 0))
+            free = self._free_chip_ids()
+            if len(free) < (tpu if tpu < total_chips else total_chips):
+                self._evict_idle_tpu_workers()
+                free = self._free_chip_ids()
+            if 0 < tpu < total_chips:
+                if len(free) < tpu:
+                    raise RuntimeError(
+                        f"need {tpu} free TPU chips, have {len(free)} "
+                        "(others held by busy workers)")
+                chips = tuple(free[:tpu])
+                env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, chips))
+                env["TPU_CHIPS"] = str(tpu)
+            elif tpu >= total_chips:
+                if len(free) < total_chips:
+                    raise RuntimeError(
+                        "whole-host TPU lease needs every chip free; "
+                        f"{total_chips - len(free)} held by busy workers")
+                # owns every chip (tracked so later subset spawns evict
+                # this worker instead of double-claiming devices)
+                chips = tuple(range(total_chips))
         # runtime env applied at spawn (reference: runtime_env_agent
         # prepares the env before the worker starts, runtime_env_agent.py:165)
         cwd = None
@@ -425,7 +522,8 @@ class Raylet:
         )
         log.close()
         handle = _WorkerHandle(worker_id, proc, tpu=tpu,
-                               env_key=self._runtime_env_key(runtime_env))
+                               env_key=self._runtime_env_key(runtime_env),
+                               chips=chips)
         self._workers[worker_id] = handle
         self._starting += 1
         return handle
@@ -445,7 +543,7 @@ class Raylet:
         self._lease_wakeup.set()
         return True
 
-    async def _pop_worker(self, tpu: bool = False,
+    async def _pop_worker(self, tpu: int = 0,
                           env_key: Optional[str] = None
                           ) -> Optional[_WorkerHandle]:
         pool = self._idle_workers[(tpu, env_key)]
@@ -629,15 +727,15 @@ class Raylet:
 
     async def _grant_lease(self, demand, pg_key, lease_type,
                            runtime_env: Optional[dict] = None):
-        needs_tpu = any(
-            k == "TPU" or k.startswith("TPU-") for k, v in demand.items()
-            if v > 0
-        )
+        tpu_chips = 0
+        for k, v in demand.items():
+            if (k == "TPU" or k.startswith("TPU-")) and v > 0:
+                tpu_chips = max(tpu_chips, int(-(-v // 1)))  # ceil
         env_key = self._runtime_env_key(runtime_env)
-        worker = await self._pop_worker(needs_tpu, env_key)
+        worker = await self._pop_worker(tpu_chips, env_key)
         if worker is None:
             try:
-                worker = self._spawn_worker(tpu=needs_tpu,
+                worker = self._spawn_worker(tpu=tpu_chips,
                                             runtime_env=runtime_env)
             except Exception as e:  # e.g. bad runtime_env working_dir
                 self._release_after_grant(demand, pg_key)
@@ -1194,6 +1292,63 @@ class Raylet:
             "workers": list(self._workers.keys()),
             "store": self.store.stats(),
         }
+
+    async def _log_monitor_loop(self):
+        """Tail THIS raylet's worker log files and publish new lines to
+        the GCS LOGS channel, which drivers echo (reference:
+        _private/log_monitor.py tailing /tmp/ray/session_*/logs into
+        GCS pubsub; worker.py prints with (pid=..., ip=...) prefixes).
+
+        session_dir may be shared by several raylets (cluster_utils),
+        so only files of workers THIS raylet spawned are tailed."""
+        offsets: Dict[str, int] = {}
+        logdir = os.path.join(self.session_dir, "logs")
+        while True:
+            await asyncio.sleep(0.3)
+            try:
+                owned = {wid[:8]: wid for wid in self._workers}
+                batch = []
+                for prefix, wid in owned.items():
+                    path = os.path.join(logdir, f"worker-{prefix}.log")
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    off = offsets.get(prefix, 0)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        data = f.read(1 << 20)
+                    # only consume complete lines: a line split
+                    # mid-write is re-read whole next tick
+                    cut = data.rfind(b"\n")
+                    if cut < 0:
+                        continue
+                    offsets[prefix] = off + cut + 1
+                    lines = data[:cut].decode(errors="replace") \
+                        .split("\n")
+                    if len(lines) > 1000:
+                        dropped = len(lines) - 1000
+                        lines = lines[:1000] + [
+                            f"[... {dropped} lines truncated by "
+                            "log streaming; full output in "
+                            f"{path} ...]"
+                        ]
+                    if lines:
+                        handle = self._workers.get(wid)
+                        batch.append({
+                            "node_id": self.node_id,
+                            "worker_id": wid,
+                            "pid": handle.proc.pid if handle else -1,
+                            "lines": lines,
+                        })
+                if batch:
+                    await self.gcs.aio.call(
+                        "publish", channel="LOGS",
+                        msg={"entries": batch})
+            except Exception:
+                pass
 
     async def list_log_files(self):
         """Log module source (reference: dashboard/modules/log — the

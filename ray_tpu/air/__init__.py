@@ -1,0 +1,14 @@
+"""AIR common layer: configs shared across Train/Tune/Serve/Data.
+
+Reference: python/ray/air/ — RunConfig/ScalingConfig/FailureConfig/
+CheckpointConfig schemas plus result/session plumbing shared by
+Train + Tune (air/config.py). The canonical definitions live in
+ray_tpu.train.api (where the reference's train v2 also re-homes them);
+this package is the stable import point:
+
+    from ray_tpu.air import RunConfig, ScalingConfig, FailureConfig
+"""
+from ..train.api import FailureConfig, RunConfig, ScalingConfig
+from ..train.checkpoint import Checkpoint
+
+__all__ = ["RunConfig", "ScalingConfig", "FailureConfig", "Checkpoint"]

@@ -186,7 +186,8 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False):
-    # Cooperative cancellation (reference: ray.cancel); best-effort.
+    # Cooperative cancellation (reference: ray.cancel); best-effort in
+    # both local and client modes.
     pass
 
 
@@ -236,6 +237,8 @@ def timeline() -> List[dict]:
     events = global_worker().gcs.get_task_events()
     out = []
     for e in events:
+        if e.get("state") == "SPAN":
+            continue  # rendered as complete slices below
         out.append(
             {
                 "name": e.get("name", ""),
@@ -245,4 +248,7 @@ def timeline() -> List[dict]:
                 "args": e,
             }
         )
+    from .util.tracing import spans_to_chrome_trace
+
+    out.extend(spans_to_chrome_trace(events))
     return out

@@ -298,6 +298,9 @@ def main():
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
     args = p.parse_args()
+    # a helper service must not echo the cluster's worker logs into its
+    # own log file (it is a driver, but not a user-facing one)
+    os.environ.setdefault("RAY_TPU_LOG_TO_DRIVER", "0")
     ray.init(address=args.address)
     head = DashboardHead(args.host, args.port).start()
     print(f"DASHBOARD_READY {head.url}", flush=True)

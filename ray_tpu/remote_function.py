@@ -92,6 +92,17 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         from ray_tpu._private.core_worker import collecting_refs
 
+        # Late-binding client dispatch: module-level @remote decoration
+        # happens before init("ray://...") — route at CALL time.
+        from ray_tpu import api as _api
+
+        if _api._client is not None:
+            if getattr(self, "_client_proxy", None) is None or \
+                    self._client_proxy_owner is not _api._client:
+                self._client_proxy = _api._client.remote(
+                    self._function, **self._options)
+                self._client_proxy_owner = _api._client
+            return self._client_proxy.remote(*args, **kwargs)
         worker = global_worker()
         if self._pickled is None:
             with collecting_refs(self._pickled_refs):

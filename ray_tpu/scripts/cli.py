@@ -119,33 +119,31 @@ def _spawn_service(py_args, session_dir, name, ready_marker,
     import subprocess
     import sys
 
-    import select
-
-    log = open(os.path.join(session_dir, "logs", f"{name}.log"), "ab")
+    log_path = os.path.join(session_dir, "logs", f"{name}.log")
+    log = open(log_path, "ab")
+    # stdout goes STRAIGHT to the log file — a pipe would break the
+    # service once the CLI (its only reader) exits; readiness is
+    # detected by polling the file for the marker
     proc = subprocess.Popen(
         [sys.executable, *py_args],
-        stdout=subprocess.PIPE, stderr=log,
+        stdout=log, stderr=subprocess.STDOUT,
         start_new_session=True,
     )
+    log.close()
     deadline = time.time() + timeout
-    buf = b""
-    fd = proc.stdout.fileno()
-    os.set_blocking(fd, False)
     while time.time() < deadline:
-        # poll-based wait: a child that hangs BEFORE printing anything
-        # must still trip the deadline (readline would block forever)
-        r, _, _ = select.select([fd], [], [], 0.5)
-        if r:
-            chunk = os.read(fd, 65536)
-            if chunk:
-                log.write(chunk)
-                buf += chunk
-                if ready_marker.encode() in buf:
+        try:
+            with open(log_path, "rb") as f:
+                if ready_marker.encode() in f.read():
                     return proc.pid
+        except OSError:
+            pass
         if proc.poll() is not None:
-            raise RuntimeError(f"{name} exited rc={proc.returncode}")
+            raise RuntimeError(
+                f"{name} exited rc={proc.returncode}; see {log_path}")
+        time.sleep(0.3)
     proc.kill()
-    raise RuntimeError(f"{name} not ready in {timeout}s")
+    raise RuntimeError(f"{name} not ready in {timeout}s; see {log_path}")
 
 
 def _alive(pid: int) -> bool:

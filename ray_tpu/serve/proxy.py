@@ -17,6 +17,83 @@ import threading
 from typing import Any, Dict, Optional
 
 
+class _AsyncResolver:
+    """Awaitable results for handle calls WITHOUT a thread per request.
+
+    The reference proxy is fully async (uvicorn + asyncio actor calls);
+    here ObjectRef completion is a threading.Event on the owner, so one
+    watcher thread multiplexes every in-flight request: it sleeps on the
+    owner's ready-condvar (kicked by _notify_ready on each completion)
+    and resolves asyncio futures back on the serving loop. In-flight
+    concurrency is bounded by memory, not by a thread-pool size."""
+
+    def __init__(self):
+        import time as _time
+
+        from .._private.core_worker import global_worker
+
+        self._time = _time
+        self._w = global_worker()
+        self._lock = threading.Lock()
+        self._pending: list = []  # [resp, fut, loop, deadline]
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-proxy-resolver")
+        self._thread.start()
+
+    async def get(self, response, timeout: float):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        with self._lock:
+            self._pending.append(
+                [response, fut, loop, self._time.monotonic() + timeout])
+        return await fut
+
+    def _run(self):
+        w = self._w
+        while True:
+            with w._ready_cv:
+                w._ready_cv.wait(0.05)
+            with self._lock:
+                if not self._pending:
+                    continue
+                items = list(self._pending)
+            now = self._time.monotonic()
+            finished = []
+            for item in items:
+                resp, fut, loop, deadline = item
+                try:
+                    ready = w._is_ready(resp.ref)
+                except Exception:
+                    ready = True
+                if not ready and now < deadline:
+                    continue
+                finished.append(item)
+                try:
+                    # ready: result() returns without blocking
+                    val = resp.result(timeout=max(0.1, deadline - now))
+                except Exception as e:  # noqa: BLE001 — forward to caller
+                    loop.call_soon_threadsafe(
+                        _set_exc_if_pending, fut, e)
+                else:
+                    loop.call_soon_threadsafe(
+                        _set_result_if_pending, fut, val)
+            if finished:
+                with self._lock:
+                    self._pending = [
+                        p for p in self._pending if p not in finished
+                    ]
+
+
+def _set_result_if_pending(fut, val):
+    if not fut.done():
+        fut.set_result(val)
+
+
+def _set_exc_if_pending(fut, e):
+    if not fut.done():
+        fut.set_exception(e)
+
+
 class ProxyActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self.host = host
@@ -25,6 +102,7 @@ class ProxyActor:
         self._handles: Dict[str, Any] = {}
         self._started = threading.Event()
         self._num_requests = 0
+        self._resolver = _AsyncResolver()
         from .._private.rpc import EventLoopThread
 
         self._loop = EventLoopThread.get().loop
@@ -89,14 +167,15 @@ class ProxyActor:
             handle = DeploymentHandle(target)
             self._handles[target] = handle
 
-        # run the blocking result() off the event loop
-        loop = asyncio.get_running_loop()
-
-        def call():
-            return handle.remote(payload).result(timeout=120)
-
         try:
-            result = await loop.run_in_executor(None, call)
+            # submission (routing + one actor push, may briefly block on
+            # a controller refresh) hops through the pool for
+            # milliseconds; the WAIT rides the shared resolver, so
+            # in-flight concurrency is not capped by pool size
+            loop = asyncio.get_running_loop()
+            response = await loop.run_in_executor(
+                None, lambda: handle.remote(payload))
+            result = await self._resolver.get(response, timeout=120.0)
         except Exception as e:  # noqa: BLE001 — surface to the client
             return web.json_response(
                 {"error": f"{type(e).__name__}: {e}"}, status=500

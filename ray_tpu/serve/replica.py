@@ -27,6 +27,15 @@ class ReplicaActor:
         self._total = 0
         self._lock = threading.Lock()
         self._start = time.time()
+        # sync user methods need one thread per in-flight request up to
+        # max_ongoing; the loop's default executor is sized to the CPU
+        # count (tiny on 1-vCPU hosts) and would silently cap throughput
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(8, max_ongoing_requests),
+            thread_name_prefix="replica",
+        )
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict):
         """Run a user method (sync methods hop to a thread; async run on
@@ -40,7 +49,7 @@ class ReplicaActor:
                 return await target(*args, **kwargs)
             loop = asyncio.get_running_loop()
             return await loop.run_in_executor(
-                None, lambda: target(*args, **kwargs)
+                self._executor, lambda: target(*args, **kwargs)
             )
         finally:
             with self._lock:

@@ -4,7 +4,11 @@ Reference: the client server the reference starts from `ray start --head
 --ray-client-server-port` (util/client/server/__main__ equivalent).
 """
 import argparse
+import os
 import threading
+
+# a helper service must not echo the cluster's worker logs
+os.environ.setdefault("RAY_TPU_LOG_TO_DRIVER", "0")
 
 import ray_tpu as ray
 from .server import ClientServer

@@ -50,9 +50,16 @@ class ClientPickler(cloudpickle.CloudPickler):
         return None
 
     def reducer_override(self, obj):
+        import types
+
         if isinstance(obj, type) and _is_client_local(obj):
             try:
                 return cloudpickle.cloudpickle._dynamic_class_reduce(obj)
+            except Exception:
+                pass
+        if isinstance(obj, types.FunctionType) and _is_client_local(obj):
+            try:
+                return self._dynamic_function_reduce(obj)
             except Exception:
                 pass
         return super().reducer_override(obj)

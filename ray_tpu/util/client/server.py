@@ -78,8 +78,16 @@ class ClientServer:
     SESSION_TTL_S = 120.0
 
     def __init__(self, host: str = "127.0.0.1", port: int = 10001):
+        from concurrent.futures import ThreadPoolExecutor
+
         self._sessions: Dict[str, _Session] = {}
         self._lock = threading.Lock()
+        # dedicated pool: untimed client_get/client_wait calls park a
+        # thread each until their ref resolves — on the loop's default
+        # (cpu-sized) executor a handful of slow gets would starve every
+        # other RPC for every session
+        self._executor = ThreadPoolExecutor(
+            max_workers=128, thread_name_prefix="client-server")
         self._server = RpcServer(host, port)
         self._server.register(self)  # methods are already client_*-named
         loop = EventLoopThread.get().loop
@@ -140,7 +148,8 @@ class ClientServer:
     #    calls hop to a thread so the loop never stalls) ---------------
     async def _in_thread(self, fn, *args, **kw):
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, lambda: fn(*args, **kw))
+        return await loop.run_in_executor(
+            self._executor, lambda: fn(*args, **kw))
 
     async def client_connect(self, namespace: str = "") -> dict:
         session_id = uuid.uuid4().hex

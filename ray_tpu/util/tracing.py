@@ -1,0 +1,111 @@
+"""Task tracing: span propagation across task/actor boundaries.
+
+Reference: python/ray/util/tracing/tracing_helper.py —
+`_tracing_task_invocation` / `_inject_tracing_into_function` (:293,:326)
+wrap submission and execution, propagating otel span context inside task
+specs; `ray timeline` exports Chrome-trace JSON.
+
+Here spans are framework-native (no otel in the image): a contextvar
+carries (trace_id, span_id); submission stamps it into the task spec;
+execution opens a child span and records it to the GCS task-event store,
+where ``ray_tpu.timeline()`` / the dashboard render Chrome-trace
+complete ("X") events with parent links.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+_ctx: contextvars.ContextVar[Optional[Dict[str, str]]] = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+
+def tracing_enabled() -> bool:
+    return os.environ.get("RAY_TPU_TRACING_ENABLED", "0") == "1"
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    return _ctx.get()
+
+
+def context_for_spec() -> Optional[Dict[str, str]]:
+    """Called at submission: the ctx stamped into the task spec."""
+    if not tracing_enabled():
+        return None
+    ctx = _ctx.get()
+    if ctx is None:
+        # root: the driver's first traced submission opens a trace
+        ctx = {"trace_id": uuid.uuid4().hex, "span_id": "root"}
+        _ctx.set(ctx)
+    return dict(ctx)
+
+
+@contextlib.contextmanager
+def span(name: str, worker=None, spec: Optional[dict] = None):
+    """Execution-side (or user-code) span. Records a complete event to
+    the worker's task-event buffer on exit."""
+    parent = None
+    if spec is not None and spec.get("trace_ctx"):
+        parent = dict(spec["trace_ctx"])
+        token = _ctx.set(parent)
+    else:
+        cur = _ctx.get()
+        parent = dict(cur) if cur else None
+        token = None
+    sid = uuid.uuid4().hex[:16]
+    mine = {
+        "trace_id": (parent or {}).get("trace_id", uuid.uuid4().hex),
+        "span_id": sid,
+    }
+    inner_token = _ctx.set(mine)
+    start = time.time()
+    try:
+        yield mine
+    finally:
+        end = time.time()
+        _ctx.reset(inner_token)
+        if token is not None:
+            _ctx.reset(token)
+        if worker is not None and tracing_enabled():
+            with worker._task_events_lock:
+                worker._task_events.append({
+                    "task_id": (spec or {}).get("task_id", b"").hex()
+                    if isinstance((spec or {}).get("task_id"), bytes)
+                    else (spec or {}).get("task_id", ""),
+                    "name": name,
+                    "state": "SPAN",
+                    "ts": start,
+                    "dur": end - start,
+                    "trace_id": mine["trace_id"],
+                    "span_id": sid,
+                    "parent_span_id": (parent or {}).get("span_id"),
+                    "node_id": worker.node_id,
+                    "job_id": (spec or {}).get("job_id"),
+                })
+
+
+def spans_to_chrome_trace(events) -> list:
+    """SPAN task events -> Chrome-trace 'X' (complete) slices."""
+    out = []
+    for e in events:
+        if e.get("state") != "SPAN":
+            continue
+        out.append({
+            "name": e.get("name", ""),
+            "cat": "task",
+            "ph": "X",
+            "ts": e["ts"] * 1e6,
+            "dur": e.get("dur", 0.0) * 1e6,
+            "pid": e.get("node_id", ""),
+            "tid": e.get("trace_id", ""),
+            "args": {
+                "span_id": e.get("span_id"),
+                "parent_span_id": e.get("parent_span_id"),
+                "task_id": e.get("task_id"),
+            },
+        })
+    return out

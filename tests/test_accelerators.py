@@ -1,0 +1,66 @@
+"""TPU chip partitioning + detection tests.
+
+Reference analogues: python/ray/tests/test_accelerator_support (chip
+visibility partitioning per worker via TPU_VISIBLE_CHIPS,
+accelerators/tpu.py:32-41).
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture(scope="module")
+def tpu_cluster():
+    ray.init(resources={"CPU": 4, "TPU": 4, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+@ray.remote
+def visible_chips():
+    import os as _os
+    import time as _time
+
+    _time.sleep(1.5)  # keep the worker busy so peers spawn fresh
+    return _os.environ.get("TPU_VISIBLE_CHIPS", "")
+
+
+def test_subset_lease_pins_visible_chips(tpu_cluster):
+    out = ray.get(
+        visible_chips.options(resources={"TPU": 2}).remote(),
+        timeout=120)
+    chips = out.split(",")
+    assert len(chips) == 2 and all(c.isdigit() for c in chips)
+
+
+def test_concurrent_leases_get_disjoint_chips(tpu_cluster):
+    refs = [
+        visible_chips.options(resources={"TPU": 2}).remote()
+        for _ in range(2)
+    ]
+    a, b = ray.get(refs, timeout=120)
+    sa, sb = set(a.split(",")), set(b.split(","))
+    assert len(sa) == 2 and len(sb) == 2
+    assert not (sa & sb), (a, b)
+
+
+def test_whole_host_lease_keeps_native_numbering(tpu_cluster):
+    out = ray.get(
+        visible_chips.options(resources={"TPU": 4}).remote(),
+        timeout=120)
+    assert out == ""  # no partitioning for whole-host workers
+
+
+def test_detection_from_device_files(monkeypatch, tmp_path):
+    from ray_tpu._private.raylet import detect_node_resources
+
+    monkeypatch.setenv("TPU_CHIPS", "8")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5p-16")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x2")
+    res, labels = detect_node_resources()
+    assert res["TPU"] == 8.0
+    assert res["TPU-v5p-16"] == 8.0
+    assert labels["tpu-topology"] == "2x2x2"

@@ -1,0 +1,100 @@
+"""Tracing + log-streaming tests.
+
+Reference analogues: python/ray/tests/test_tracing.py (span per task
+with propagated parent context), test_output.py (worker logs echoed to
+the driver with pid prefixes).
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture(scope="module")
+def traced_ray():
+    os.environ["RAY_TPU_TRACING_ENABLED"] = "1"
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    ray.shutdown()
+    os.environ.pop("RAY_TPU_TRACING_ENABLED", None)
+
+
+def test_task_spans_recorded_with_parenting(traced_ray):
+    @ray.remote
+    def child():
+        return 1
+
+    @ray.remote
+    def parent():
+        import ray_tpu as inner_ray
+
+        return inner_ray.get(child.remote(), timeout=60)
+
+    assert ray.get(parent.remote(), timeout=120) == 1
+    deadline = time.time() + 30
+    spans = []
+    while time.time() < deadline:
+        spans = [e for e in ray.timeline() if e.get("ph") == "X"]
+        if len(spans) >= 2:
+            break
+        time.sleep(0.5)
+    names = {s["name"] for s in spans}
+    assert {"parent", "child"} <= names
+    par = next(s for s in spans if s["name"] == "parent")
+    chi = next(s for s in spans if s["name"] == "child")
+    # same trace; the child's parent span is the parent task's span
+    assert par["tid"] == chi["tid"]
+    assert chi["args"]["parent_span_id"] == par["args"]["span_id"]
+    assert chi["dur"] > 0
+
+
+def test_user_span_api(traced_ray):
+    from ray_tpu.util import tracing
+
+    @ray.remote
+    def work():
+        from ray_tpu.util import tracing as t
+        import ray_tpu.api as api
+
+        with t.span("inner_phase", worker=api.global_worker()):
+            return 5
+
+    assert ray.get(work.remote(), timeout=60) == 5
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        spans = [e for e in ray.timeline() if e.get("ph") == "X"]
+        if any(s["name"] == "inner_phase" for s in spans):
+            break
+        time.sleep(0.5)
+    assert any(s["name"] == "inner_phase" for s in spans)
+
+
+def test_worker_logs_stream_to_driver():
+    """Full-process test: driver stderr must carry the worker's print
+    with a (pid=..., node=...) prefix."""
+    script = (
+        "import ray_tpu as ray, time\n"
+        "ray.init(resources={'CPU': 2})\n"
+        "@ray.remote\n"
+        "def f():\n"
+        "    print('LOGSTREAM_MARKER_XYZ')\n"
+        "    return 0\n"
+        "ray.get(f.remote(), timeout=60)\n"
+        "time.sleep(2.0)\n"
+        "ray.shutdown()\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", script], env=env, cwd="/root/repo",
+        capture_output=True, text=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = next(
+        (l for l in out.stderr.splitlines()
+         if "LOGSTREAM_MARKER_XYZ" in l), "")
+    assert line.startswith("(pid="), line
