@@ -404,9 +404,19 @@ class CoreWorker:
         loop.spawn(self._metrics_flush_loop())
         if self.mode == "driver" and self._cfg.log_to_driver:
             loop.spawn(self._log_stream_loop())
+        if self.mode == "worker" and self._cfg.log_to_driver:
+            self._install_log_tee()
+            loop.spawn(self._log_publish_loop())
 
     def shutdown(self):
         self._exit.set()
+        if self.mode == "driver":
+            try:  # release pubsub queues the GCS would otherwise retain
+                for sid in (f"logs-{self.worker_id}",
+                            f"cw-{self.worker_id}"):
+                    self.gcs.unsubscribe(sub_id=sid, timeout=2.0)
+            except Exception:
+                pass
         if self._cfg.metrics_export_port >= 0:
             try:
                 from .metrics import get_registry
@@ -1087,9 +1097,7 @@ class CoreWorker:
             spec["runtime_env"] = runtime_env
         from ..util import tracing as _tracing
 
-        trace_ctx = _tracing.context_for_spec()
-        if trace_ctx:
-            spec["trace_ctx"] = trace_ctx
+        _tracing.stamp_spec(spec)
         return_ids = [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
@@ -1415,9 +1423,7 @@ class CoreWorker:
             spec["tensor_transport"] = tensor_transport
         from ..util import tracing as _tracing
 
-        trace_ctx = _tracing.context_for_spec()
-        if trace_ctx:
-            spec["trace_ctx"] = trace_ctx
+        _tracing.stamp_spec(spec)
         for r in arg_refs:
             self._retain_ref(r.id, r.owner_address)
         with self._records_lock:
@@ -1532,17 +1538,14 @@ class CoreWorker:
         return True
 
     def _execute_task(self, spec: dict):
+        self._set_log_job(spec)
         try:
             func = self._load_function(spec)
             args = [self._unpack_arg(a) for a in spec["args"]]
             kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
-            if spec.get("trace_ctx"):
-                from ..util import tracing
+            from ..util import tracing
 
-                with tracing.span(spec.get("name", "task"), worker=self,
-                                  spec=spec):
-                    result = func(*args, **kwargs)
-            else:
+            with tracing.task_span(spec, self):
                 result = func(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — shipped to the owner
             tb = traceback.format_exc()
@@ -1759,6 +1762,7 @@ class CoreWorker:
 
     async def _run_actor_method(self, spec: dict):
         loop = asyncio.get_running_loop()
+        self._set_log_job(spec)
         method = getattr(self.actor_instance, spec["method"], None)
         if method is None:
             return self._actor_error_reply(
@@ -1794,17 +1798,14 @@ class CoreWorker:
         )
 
     def _execute_actor_task_sync(self, spec: dict):
+        self._set_log_job(spec)
         method = getattr(self.actor_instance, spec["method"])
         args = [self._unpack_arg(a) for a in spec["args"]]
         kwargs = {k: self._unpack_arg(v) for k, v in spec["kwargs"].items()}
         try:
-            if spec.get("trace_ctx"):
-                from ..util import tracing
+            from ..util import tracing
 
-                with tracing.span(spec.get("name", "actor_task"),
-                                  worker=self, spec=spec):
-                    result = method(*args, **kwargs)
-            else:
+            with tracing.task_span(spec, self):
                 result = method(*args, **kwargs)
         except Exception as e:  # noqa: BLE001
             return self._actor_error_reply(spec, e)
@@ -2205,10 +2206,72 @@ class CoreWorker:
             except Exception:
                 await asyncio.sleep(0.5)
 
+    def _set_log_job(self, spec: dict):
+        tls = getattr(self, "_log_job_tls", None)
+        if tls is not None:
+            tls.job = spec.get("job_id")
+
+    # -- worker side: tee stdout/stderr, publish job-tagged lines ------
+    def _install_log_tee(self):
+        """Wrap stdout/stderr so each line is both written to the
+        session log file (the raylet's redirection) AND published to
+        the GCS LOGS channel tagged with the job of the task running on
+        the writing thread — so drivers echo only THEIR job's output
+        (reference: log_monitor.py + worker.py print_logs, which filter
+        by job)."""
+        import sys
+
+        self._log_buf: List[tuple] = []  # (job_id_hex | None, line)
+        self._log_buf_lock = threading.Lock()
+        self._log_job_tls = threading.local()
+        sys.stdout = _LogTee(sys.stdout, self)
+        sys.stderr = _LogTee(sys.stderr, self)
+
+    def _append_log_line(self, line: str):
+        job = getattr(self._log_job_tls, "job", None)
+        with self._log_buf_lock:
+            if len(self._log_buf) < 10000:
+                self._log_buf.append((job, line))
+            elif len(self._log_buf) == 10000:
+                self._log_buf.append(
+                    (job, "[... output truncated by log streaming; "
+                          "full log in the session dir ...]"))
+
+    async def _log_publish_loop(self):
+        import os as _os
+
+        while not self._exit.is_set():
+            await asyncio.sleep(0.3)
+            with self._log_buf_lock:
+                if not self._log_buf:
+                    continue
+                buf, self._log_buf = self._log_buf, []
+            by_job: Dict[Optional[str], List[str]] = {}
+            for job, line in buf:
+                by_job.setdefault(job, []).append(line)
+            entries = [
+                {
+                    "node_id": self.node_id,
+                    "worker_id": self.worker_id,
+                    "pid": _os.getpid(),
+                    "job_id": job,
+                    "lines": lines,
+                }
+                for job, lines in by_job.items()
+            ]
+            try:
+                await self.gcs.aio.call(
+                    "publish", channel="LOGS", msg={"entries": entries})
+            except Exception:
+                pass
+
+    # -- driver side: subscribe + echo my job's lines ------------------
     async def _log_stream_loop(self):
         """Echo worker stdout/stderr to the driver's terminal with
         (pid=..., node=...) prefixes (reference: worker.py's
-        print_logs fed by the log monitor via GCS pubsub)."""
+        print_logs fed via GCS pubsub). Only lines attributed to THIS
+        driver's job are echoed; unattributed lines (worker boot noise)
+        are skipped."""
         import sys
 
         sub_id = f"logs-{self.worker_id}"
@@ -2228,6 +2291,8 @@ class CoreWorker:
                     continue
                 for _channel, msg in msgs:
                     for entry in msg.get("entries", ()):
+                        if entry.get("job_id") != self.job_id.hex():
+                            continue
                         prefix = (f"(pid={entry['pid']}, "
                                   f"node={entry['node_id'][:8]})")
                         for line in entry["lines"]:
@@ -2252,6 +2317,34 @@ class CoreWorker:
 # Lease pool: one per scheduling class (reference: NormalTaskSubmitter's
 # per-SchedulingKey lease management, normal_task_submitter.h:79)
 # ---------------------------------------------------------------------------
+class _LogTee:
+    """stdout/stderr wrapper on workers: passes writes through to the
+    original stream (the raylet's per-worker log file) and buffers
+    complete lines for job-tagged publishing."""
+
+    def __init__(self, orig, worker: "CoreWorker"):
+        self._orig = orig
+        self._worker = worker
+        self._partial = ""
+        self._lock = threading.Lock()
+
+    def write(self, s: str) -> int:
+        n = self._orig.write(s)
+        with self._lock:
+            self._partial += s
+            while "\n" in self._partial:
+                line, self._partial = self._partial.split("\n", 1)
+                if line:
+                    self._worker._append_log_line(line)
+        return n if isinstance(n, int) else len(s)
+
+    def flush(self):
+        self._orig.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._orig, name)
+
+
 class _BatchReporter:
     """Streams completed-but-unreplied batch results to their owners on
     a 5ms timer; results still pending when the batch reply goes out are

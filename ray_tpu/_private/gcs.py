@@ -296,6 +296,7 @@ class GcsServer:
             "channels": set(channels),
             "queue": collections.deque(maxlen=100000),
             "event": asyncio.Event(),
+            "last_poll": time.time(),
         }
         return True
 
@@ -303,10 +304,19 @@ class GcsServer:
         self._subscribers.pop(sub_id, None)
         return True
 
+    def _purge_dead_subscribers(self):
+        """Drop subscribers that stopped polling (dead drivers would
+        otherwise retain every future publish forever)."""
+        cutoff = time.time() - 90.0
+        for sid, sub in list(self._subscribers.items()):
+            if sub["last_poll"] < cutoff:
+                del self._subscribers[sid]
+
     async def poll(self, sub_id: str, timeout_s: float = 10.0):
         sub = self._subscribers.get(sub_id)
         if sub is None:
             return None  # tells client to re-subscribe
+        sub["last_poll"] = time.time()
         if not sub["queue"]:
             sub["event"].clear()
             try:
@@ -470,6 +480,7 @@ class GcsServer:
     async def _health_check_loop(self):
         while True:
             await asyncio.sleep(self._hb_period)
+            self._purge_dead_subscribers()
             deadline = time.time() - self._hb_period * self._hb_threshold
             for nid, v in list(self._node_views.items()):
                 if v.alive and self._last_heartbeat.get(nid, 0) < deadline:

@@ -299,8 +299,6 @@ class Raylet:
         )
         self._bg.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._bg.append(asyncio.ensure_future(self._lease_grant_loop()))
-        if self._cfg.log_to_driver:
-            self._bg.append(asyncio.ensure_future(self._log_monitor_loop()))
         self._bg.append(asyncio.ensure_future(self._worker_watcher_loop()))
         if self._cfg.memory_usage_threshold > 0:
             self._bg.append(
@@ -425,7 +423,11 @@ class Raylet:
 
     def _evict_idle_tpu_workers(self):
         """Terminate idle chip-holding workers so their chips can be
-        re-pinned (they keep libtpu ownership while pooled)."""
+        re-pinned (they keep libtpu ownership while pooled), waiting
+        for the processes to actually exit — libtpu releases its
+        device locks at teardown, so re-pinning before exit would race
+        the old owner."""
+        victims = []
         for (tpu, env_key), pool in list(self._idle_workers.items()):
             if not tpu:
                 continue
@@ -440,6 +442,16 @@ class Raylet:
                 except Exception:
                     pass
                 self._workers.pop(wid, None)
+                victims.append(h.proc)
+        deadline = time.time() + 5.0
+        for proc in victims:
+            try:
+                proc.wait(max(0.1, deadline - time.time()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
 
     def _spawn_worker(self, tpu: int = 0,
                       runtime_env: Optional[dict] = None) -> _WorkerHandle:
@@ -461,7 +473,7 @@ class Raylet:
             # jax at the backend we just disabled.
             env["PALLAS_AXON_POOL_IPS"] = ""
             env["JAX_PLATFORMS"] = "cpu"
-        else:
+        elif tpu > 0:
             # Partition the host's chips: a k-chip lease gets a worker
             # that sees exactly k chips (reference: TPU_VISIBLE_CHIPS
             # isolation, accelerators/tpu.py:32-41). Only set when a
@@ -470,6 +482,8 @@ class Raylet:
             # ownership of their chips, so when free ids don't cover
             # the request, evict idle TPU workers first; an unpinned
             # worker next to pinned ones would fight over devices.
+            # (tpu < 0 = fractional/shared demand: TPU runtime with no
+            # pinning — exclusivity was already waived by the user.)
             total_chips = int(self.total.get("TPU", 0))
             free = self._free_chip_ids()
             if len(free) < (tpu if tpu < total_chips else total_chips):
@@ -727,10 +741,22 @@ class Raylet:
 
     async def _grant_lease(self, demand, pg_key, lease_type,
                            runtime_env: Optional[dict] = None):
+        # Whole-chip demands pin TPU_VISIBLE_CHIPS subsets; FRACTIONAL
+        # demands (admitted by resource accounting, e.g. two TPU:0.5
+        # tasks on one chip) share unpinned TPU workers instead — a
+        # fractional lease must never hard-fail on chip exclusivity.
         tpu_chips = 0
+        fractional = False
         for k, v in demand.items():
             if (k == "TPU" or k.startswith("TPU-")) and v > 0:
-                tpu_chips = max(tpu_chips, int(-(-v // 1)))  # ceil
+                if v != int(v):
+                    fractional = True
+                tpu_chips = max(tpu_chips, int(v))
+        if fractional or (tpu_chips == 0 and any(
+            (k == "TPU" or k.startswith("TPU-")) and v > 0
+            for k, v in demand.items()
+        )):
+            tpu_chips = -1  # TPU runtime, no chip pinning (shared pool)
         env_key = self._runtime_env_key(runtime_env)
         worker = await self._pop_worker(tpu_chips, env_key)
         if worker is None:
@@ -1292,63 +1318,6 @@ class Raylet:
             "workers": list(self._workers.keys()),
             "store": self.store.stats(),
         }
-
-    async def _log_monitor_loop(self):
-        """Tail THIS raylet's worker log files and publish new lines to
-        the GCS LOGS channel, which drivers echo (reference:
-        _private/log_monitor.py tailing /tmp/ray/session_*/logs into
-        GCS pubsub; worker.py prints with (pid=..., ip=...) prefixes).
-
-        session_dir may be shared by several raylets (cluster_utils),
-        so only files of workers THIS raylet spawned are tailed."""
-        offsets: Dict[str, int] = {}
-        logdir = os.path.join(self.session_dir, "logs")
-        while True:
-            await asyncio.sleep(0.3)
-            try:
-                owned = {wid[:8]: wid for wid in self._workers}
-                batch = []
-                for prefix, wid in owned.items():
-                    path = os.path.join(logdir, f"worker-{prefix}.log")
-                    try:
-                        size = os.path.getsize(path)
-                    except OSError:
-                        continue
-                    off = offsets.get(prefix, 0)
-                    if size <= off:
-                        continue
-                    with open(path, "rb") as f:
-                        f.seek(off)
-                        data = f.read(1 << 20)
-                    # only consume complete lines: a line split
-                    # mid-write is re-read whole next tick
-                    cut = data.rfind(b"\n")
-                    if cut < 0:
-                        continue
-                    offsets[prefix] = off + cut + 1
-                    lines = data[:cut].decode(errors="replace") \
-                        .split("\n")
-                    if len(lines) > 1000:
-                        dropped = len(lines) - 1000
-                        lines = lines[:1000] + [
-                            f"[... {dropped} lines truncated by "
-                            "log streaming; full output in "
-                            f"{path} ...]"
-                        ]
-                    if lines:
-                        handle = self._workers.get(wid)
-                        batch.append({
-                            "node_id": self.node_id,
-                            "worker_id": wid,
-                            "pid": handle.proc.pid if handle else -1,
-                            "lines": lines,
-                        })
-                if batch:
-                    await self.gcs.aio.call(
-                        "publish", channel="LOGS",
-                        msg={"entries": batch})
-            except Exception:
-                pass
 
     async def list_log_files(self):
         """Log module source (reference: dashboard/modules/log — the

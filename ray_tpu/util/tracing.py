@@ -44,6 +44,25 @@ def context_for_spec() -> Optional[Dict[str, str]]:
     return dict(ctx)
 
 
+def stamp_spec(spec: dict) -> None:
+    """Submission-side: stamp the current trace context into a task
+    spec (no-op when tracing is disabled)."""
+    ctx = context_for_spec()
+    if ctx:
+        spec["trace_ctx"] = ctx
+
+
+@contextlib.contextmanager
+def task_span(spec: dict, worker):
+    """Execution-side: open a span for a task spec, or no-op when the
+    spec carries no trace context."""
+    if not spec.get("trace_ctx"):
+        yield None
+        return
+    with span(spec.get("name", "task"), worker=worker, spec=spec) as s:
+        yield s
+
+
 @contextlib.contextmanager
 def span(name: str, worker=None, spec: Optional[dict] = None):
     """Execution-side (or user-code) span. Records a complete event to

@@ -54,7 +54,7 @@ def test_whole_host_lease_keeps_native_numbering(tpu_cluster):
     assert out == ""  # no partitioning for whole-host workers
 
 
-def test_detection_from_device_files(monkeypatch, tmp_path):
+def test_detection_from_env(monkeypatch):
     from ray_tpu._private.raylet import detect_node_resources
 
     monkeypatch.setenv("TPU_CHIPS", "8")
@@ -64,3 +64,31 @@ def test_detection_from_device_files(monkeypatch, tmp_path):
     assert res["TPU"] == 8.0
     assert res["TPU-v5p-16"] == 8.0
     assert labels["tpu-topology"] == "2x2x2"
+
+
+def test_detection_from_device_files(monkeypatch):
+    import glob as glob_mod
+
+    from ray_tpu._private.raylet import detect_node_resources
+
+    monkeypatch.delenv("TPU_CHIPS", raising=False)
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.setattr(
+        glob_mod, "glob",
+        lambda p: (["/dev/accel0", "/dev/accel1", "/dev/accel2",
+                    "/dev/accel3"] if p == "/dev/accel*" else []),
+    )
+    res, labels = detect_node_resources()
+    assert res["TPU"] == 4.0
+    assert labels["tpu-accelerator-type"] == "unknown"
+
+
+def test_fractional_tpu_demand_shares_chips(tpu_cluster):
+    # two TPU:0.5 leases fit one chip's accounting; neither may pin —
+    # they run in shared unpinned workers rather than hard-failing
+    refs = [
+        visible_chips.options(resources={"TPU": 0.5}).remote()
+        for _ in range(2)
+    ]
+    a, b = ray.get(refs, timeout=120)
+    assert a == "" and b == ""
