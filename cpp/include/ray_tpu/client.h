@@ -1,0 +1,170 @@
+// C++ worker API: a native client for a ray_tpu cluster.
+//
+// Reference: cpp/include/ray/api.h — the reference's C++ worker links
+// libcoreworker and drives gRPC. Here the native client speaks the
+// framework's own RPC framing (8-byte LE length + pickle, see
+// _private/rpc.py) against the Ray Client server (util/client/server.py),
+// which hosts per-session proxy state; cross-language calls go through
+// the by-name function registry (ray_tpu/cross_language.py) with bytes
+// payloads — the same function-descriptor-by-name shape the reference
+// uses for cross-language invocation (python/ray/cross_language.py).
+//
+// The OBJECT plane needs no RPC at all: link libshmstore.so (the same
+// C ABI the Python client binds with ctypes) to read/write the node's
+// shared-memory arena zero-copy. See examples/cross_lang.cc.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+
+#include "pickle.h"
+
+namespace ray_tpu {
+
+class RpcError : public std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// Blocking single-connection RPC client (one in-flight call at a time;
+// the server replies per-seq so pipelining is possible, but the C++
+// worker API keeps the surface synchronous like the reference's).
+class RpcClient {
+ public:
+  RpcClient(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw RpcError("socket() failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw RpcError("bad address: " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw RpcError("connect() failed to " + host);
+  }
+  ~RpcClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  RpcClient(const RpcClient&) = delete;
+  RpcClient& operator=(const RpcClient&) = delete;
+
+  Value Call(const std::string& method, const ValueDict& kwargs) {
+    std::lock_guard<std::mutex> lock(mu_);
+    int64_t seq = next_seq_++;
+    std::string payload = pickle::EncodeCall(seq, method, kwargs);
+    char hdr[8];
+    uint64_t n = payload.size();
+    std::memcpy(hdr, &n, 8);
+    WriteAll(hdr, 8);
+    WriteAll(payload.data(), payload.size());
+    // read frames until our seq answers (the server may interleave)
+    for (;;) {
+      char rhdr[8];
+      ReadAll(rhdr, 8);
+      uint64_t rn;
+      std::memcpy(&rn, rhdr, 8);
+      std::string data(rn, '\0');
+      ReadAll(data.data(), rn);
+      Value frame = pickle::Decode(data);
+      const ValueList& tup = frame.as_list();  // (seq, status, result)
+      if (tup.at(0).as_int() != seq) continue;
+      if (tup.at(1).as_int() != 0)
+        throw RpcError("remote error: " + tup.at(2).as_str());
+      return tup.at(2);
+    }
+  }
+
+ private:
+  void WriteAll(const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::write(fd_, p, n);
+      if (w <= 0) throw RpcError("write() failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+  void ReadAll(char* p, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::read(fd_, p, n);
+      if (r <= 0) throw RpcError("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  int fd_ = -1;
+  int64_t next_seq_ = 1;
+  std::mutex mu_;
+};
+
+// One session against the Ray Client server: put/get objects, call
+// registered cross-language functions, query cluster state.
+class ClientSession {
+ public:
+  ClientSession(const std::string& host, int port) : rpc_(host, port) {
+    Value res = rpc_.Call("client_connect", {{"namespace", Value("")}});
+    session_id_ = res.at("session_id").as_str();
+  }
+  ~ClientSession() {
+    try {
+      rpc_.Call("client_disconnect", WithSession({}));
+    } catch (...) {
+    }
+  }
+
+  // Store bytes in the cluster object store; returns the ref id.
+  std::string PutBytes(const std::string& data) {
+    Value res = rpc_.Call(
+        "client_put_bytes", WithSession({{"payload", Value::Bytes(data)}}));
+    return res.as_str();
+  }
+
+  // Fetch an object produced by a cross-language call (bytes out).
+  std::string GetBytes(const std::string& ref_id, double timeout_s = 60.0) {
+    Value res = rpc_.Call(
+        "client_get_bytes",
+        WithSession({{"ref_id", Value(ref_id)},
+                     {"get_timeout", Value(timeout_s)}}));
+    return res.as_bytes();
+  }
+
+  // Invoke a Python function registered via
+  // ray_tpu.cross_language.register_function(name, fn); the function
+  // receives the payload bytes and must return bytes. Returns a ref id.
+  std::string CallNamed(const std::string& func_name,
+                        const std::string& payload) {
+    Value res = rpc_.Call(
+        "client_task_by_name",
+        WithSession({{"func_name", Value(func_name)},
+                     {"payload", Value::Bytes(payload)}}));
+    return res.as_str();
+  }
+
+  // Cluster info passthrough ("nodes", "cluster_resources", ...).
+  Value Api(const std::string& method) {
+    return rpc_.Call("client_api",
+                     WithSession({{"api_method", Value(method)}}));
+  }
+
+  const std::string& session_id() const { return session_id_; }
+
+ private:
+  ValueDict WithSession(ValueDict kwargs) {
+    kwargs["session_id"] = Value(session_id_);
+    return kwargs;
+  }
+
+  RpcClient rpc_;
+  std::string session_id_;
+};
+
+}  // namespace ray_tpu

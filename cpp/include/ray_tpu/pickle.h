@@ -1,0 +1,400 @@
+// Pickle-subset codec for the ray_tpu RPC wire format.
+//
+// Reference analogue: cpp/include/ray/api/serializer.h — the reference's
+// C++ worker serializes with msgpack because its transport is gRPC;
+// here the transport frames are Python pickles of plain
+// (seq, method, kwargs) tuples, so the C++ worker speaks exactly the
+// value subset both ends actually use: None, bool, int, float, str,
+// bytes, list, tuple, dict[str->value].
+//
+// Encoder emits protocol 2 (universally loadable); decoder handles the
+// opcodes CPython's protocol-5 pickler produces for this subset.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+class Value;
+using ValueList = std::vector<Value>;
+using ValueDict = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Kind { kNone, kBool, kInt, kFloat, kStr, kBytes, kList, kDict };
+
+  Value() : kind_(Kind::kNone) {}
+  Value(bool b) : kind_(Kind::kBool), int_(b ? 1 : 0) {}
+  Value(int64_t i) : kind_(Kind::kInt), int_(i) {}
+  Value(int i) : kind_(Kind::kInt), int_(i) {}
+  Value(double d) : kind_(Kind::kFloat), float_(d) {}
+  Value(const char* s) : kind_(Kind::kStr), str_(s) {}
+  Value(std::string s) : kind_(Kind::kStr), str_(std::move(s)) {}
+  static Value Bytes(std::string b) {
+    Value v;
+    v.kind_ = Kind::kBytes;
+    v.str_ = std::move(b);
+    return v;
+  }
+  Value(ValueList l) : kind_(Kind::kList), list_(std::move(l)) {}
+  Value(ValueDict d) : kind_(Kind::kDict), dict_(std::move(d)) {}
+
+  Kind kind() const { return kind_; }
+  bool is_none() const { return kind_ == Kind::kNone; }
+  bool as_bool() const { return int_ != 0; }
+  int64_t as_int() const { return int_; }
+  double as_float() const {
+    return kind_ == Kind::kInt ? static_cast<double>(int_) : float_;
+  }
+  const std::string& as_str() const { return str_; }
+  const std::string& as_bytes() const { return str_; }
+  const ValueList& as_list() const { return list_; }
+  const ValueDict& as_dict() const { return dict_; }
+  const Value& at(const std::string& key) const { return dict_.at(key); }
+
+ private:
+  Kind kind_;
+  int64_t int_ = 0;
+  double float_ = 0.0;
+  std::string str_;
+  ValueList list_;
+  ValueDict dict_;
+};
+
+namespace pickle {
+
+// ---------------------------------------------------------------- encode
+inline void PutU32(std::string& out, uint32_t v) {
+  char b[4];
+  std::memcpy(b, &v, 4);  // little-endian hosts only (x86/arm64)
+  out.append(b, 4);
+}
+
+inline void Encode(const Value& v, std::string& out);
+
+inline void EncodeStr(const std::string& s, std::string& out) {
+  out.push_back('X');  // BINUNICODE
+  PutU32(out, static_cast<uint32_t>(s.size()));
+  out += s;
+}
+
+inline void Encode(const Value& v, std::string& out) {
+  switch (v.kind()) {
+    case Value::Kind::kNone:
+      out.push_back('N');
+      break;
+    case Value::Kind::kBool:
+      out += v.as_bool() ? "\x88" : "\x89";  // NEWTRUE / NEWFALSE
+      break;
+    case Value::Kind::kInt: {
+      int64_t i = v.as_int();
+      if (i >= 0 && i < (1LL << 31)) {
+        out.push_back('J');  // BININT (signed 4-byte)
+        PutU32(out, static_cast<uint32_t>(i));
+      } else {
+        out += "\x8a\x08";  // LONG1, 8 bytes
+        char b[8];
+        std::memcpy(b, &i, 8);
+        out.append(b, 8);
+      }
+      break;
+    }
+    case Value::Kind::kFloat: {
+      out.push_back('G');  // BINFLOAT (big-endian IEEE754)
+      double d = v.as_float();
+      uint64_t u;
+      std::memcpy(&u, &d, 8);
+      for (int i = 7; i >= 0; --i)
+        out.push_back(static_cast<char>((u >> (i * 8)) & 0xff));
+      break;
+    }
+    case Value::Kind::kStr:
+      EncodeStr(v.as_str(), out);
+      break;
+    case Value::Kind::kBytes: {
+      const std::string& b = v.as_bytes();
+      out.push_back('B');  // BINBYTES
+      PutU32(out, static_cast<uint32_t>(b.size()));
+      out += b;
+      break;
+    }
+    case Value::Kind::kList: {
+      out.push_back(']');  // EMPTY_LIST
+      out.push_back('(');  // MARK
+      for (const auto& item : v.as_list()) Encode(item, out);
+      out.push_back('e');  // APPENDS
+      break;
+    }
+    case Value::Kind::kDict: {
+      out.push_back('}');  // EMPTY_DICT
+      out.push_back('(');  // MARK
+      for (const auto& [k, val] : v.as_dict()) {
+        EncodeStr(k, out);
+        Encode(val, out);
+      }
+      out.push_back('u');  // SETITEMS
+      break;
+    }
+  }
+}
+
+// Encodes the request frame payload: the (seq, method, kwargs) tuple.
+inline std::string EncodeCall(int64_t seq, const std::string& method,
+                              const ValueDict& kwargs) {
+  std::string out("\x80\x02", 2);  // PROTO 2
+  Value seq_v(seq);
+  Encode(seq_v, out);
+  EncodeStr(method, out);
+  Encode(Value(kwargs), out);
+  out += "\x87";  // TUPLE3
+  out.push_back('.');  // STOP
+  return out;
+}
+
+// ---------------------------------------------------------------- decode
+class Decoder {
+ public:
+  explicit Decoder(const std::string& data) : d_(data) {}
+
+  Value Parse() {
+    while (pos_ < d_.size()) {
+      unsigned char op = Next();
+      switch (op) {
+        case 0x80:  // PROTO
+          Next();
+          break;
+        case 0x95:  // FRAME
+          pos_ += 8;
+          break;
+        case '.':  // STOP
+          if (stack_.empty()) throw std::runtime_error("pickle: empty");
+          return stack_.back();
+        case 'N':
+          Push(Value());
+          break;
+        case 0x88:
+          Push(Value(true));
+          break;
+        case 0x89:
+          Push(Value(false));
+          break;
+        case 'K':  // BININT1
+          Push(Value(static_cast<int64_t>(Next())));
+          break;
+        case 'M': {  // BININT2
+          uint16_t v = Next();
+          v |= static_cast<uint16_t>(Next()) << 8;
+          Push(Value(static_cast<int64_t>(v)));
+          break;
+        }
+        case 'J': {  // BININT
+          int32_t v;
+          ReadRaw(&v, 4);
+          Push(Value(static_cast<int64_t>(v)));
+          break;
+        }
+        case 0x8a: {  // LONG1
+          unsigned char n = Next();
+          if (n > 8) throw std::runtime_error("pickle: LONG1 too wide");
+          int64_t v = 0;
+          unsigned char last = 0;
+          for (int i = 0; i < n; ++i) {
+            last = Next();
+            v |= static_cast<int64_t>(last) << (i * 8);
+          }
+          if (n > 0 && n < 8 && (last & 0x80))  // sign-extend
+            v -= (1LL << (n * 8));
+          Push(Value(v));
+          break;
+        }
+        case 'G': {  // BINFLOAT (big-endian)
+          uint64_t u = 0;
+          for (int i = 0; i < 8; ++i) u = (u << 8) | Next();
+          double dv;
+          std::memcpy(&dv, &u, 8);
+          Push(Value(dv));
+          break;
+        }
+        case 0x8c: {  // SHORT_BINUNICODE
+          unsigned char n = Next();
+          Push(Value(ReadStr(n)));
+          break;
+        }
+        case 'X': {  // BINUNICODE
+          uint32_t n;
+          ReadRaw(&n, 4);
+          Push(Value(ReadStr(n)));
+          break;
+        }
+        case 'C': {  // SHORT_BINBYTES
+          unsigned char n = Next();
+          Push(Value::Bytes(ReadStr(n)));
+          break;
+        }
+        case 'B': {  // BINBYTES
+          uint32_t n;
+          ReadRaw(&n, 4);
+          Push(Value::Bytes(ReadStr(n)));
+          break;
+        }
+        case 0x8e: {  // BINBYTES8
+          uint64_t n;
+          ReadRaw(&n, 8);
+          Push(Value::Bytes(ReadStr(n)));
+          break;
+        }
+        case 0x94:  // MEMOIZE (implicit next index)
+          memo_.push_back(stack_.back());
+          break;
+        case 'q': {  // BINPUT
+          size_t i = Next();
+          if (memo_.size() <= i) memo_.resize(i + 1);
+          memo_[i] = stack_.back();
+          break;
+        }
+        case 'r': {  // LONG_BINPUT
+          uint32_t i;
+          ReadRaw(&i, 4);
+          if (memo_.size() <= i) memo_.resize(i + 1);
+          memo_[i] = stack_.back();
+          break;
+        }
+        case 'h':  // BINGET
+          Push(memo_.at(Next()));
+          break;
+        case 'j': {  // LONG_BINGET
+          uint32_t i;
+          ReadRaw(&i, 4);
+          Push(memo_.at(i));
+          break;
+        }
+        case '(':  // MARK
+          marks_.push_back(stack_.size());
+          break;
+        case ']':  // EMPTY_LIST
+          Push(Value(ValueList{}));
+          break;
+        case '}':  // EMPTY_DICT
+          Push(Value(ValueDict{}));
+          break;
+        case 'a': {  // APPEND (single)
+          Value item = Pop();
+          ValueList base = stack_.back().as_list();
+          stack_.pop_back();
+          base.push_back(std::move(item));
+          Push(Value(std::move(base)));
+          break;
+        }
+        case 'e': {  // APPENDS
+          size_t m = PopMark();
+          ValueList items(stack_.begin() + m, stack_.end());
+          stack_.resize(m);
+          ValueList base = stack_.back().as_list();
+          stack_.pop_back();
+          for (auto& it : items) base.push_back(std::move(it));
+          Push(Value(std::move(base)));
+          break;
+        }
+        case 'u': {  // SETITEMS
+          size_t m = PopMark();
+          ValueDict d = MakeDict(m);
+          ValueDict base = stack_.back().as_dict();
+          stack_.pop_back();
+          for (auto& [k, val] : d) base[k] = std::move(val);
+          Push(Value(std::move(base)));
+          break;
+        }
+        case 's': {  // SETITEM
+          Value val = Pop();
+          Value key = Pop();
+          ValueDict base = stack_.back().as_dict();
+          stack_.pop_back();
+          base[key.as_str()] = std::move(val);
+          Push(Value(std::move(base)));
+          break;
+        }
+        case 0x85: {  // TUPLE1 (as list)
+          Value a = Pop();
+          Push(Value(ValueList{std::move(a)}));
+          break;
+        }
+        case 0x86: {  // TUPLE2
+          Value b = Pop(), a = Pop();
+          Push(Value(ValueList{std::move(a), std::move(b)}));
+          break;
+        }
+        case 0x87: {  // TUPLE3
+          Value c = Pop(), b = Pop(), a = Pop();
+          Push(Value(ValueList{std::move(a), std::move(b), std::move(c)}));
+          break;
+        }
+        case 't': {  // TUPLE (from mark)
+          size_t m = PopMark();
+          ValueList items(stack_.begin() + m, stack_.end());
+          stack_.resize(m);
+          Push(Value(std::move(items)));
+          break;
+        }
+        case ')':  // EMPTY_TUPLE
+          Push(Value(ValueList{}));
+          break;
+        default:
+          throw std::runtime_error("pickle: unsupported opcode " +
+                                   std::to_string(op));
+      }
+    }
+    throw std::runtime_error("pickle: no STOP");
+  }
+
+ private:
+  unsigned char Next() {
+    if (pos_ >= d_.size()) throw std::runtime_error("pickle: truncated");
+    return static_cast<unsigned char>(d_[pos_++]);
+  }
+  void ReadRaw(void* dst, size_t n) {
+    if (pos_ + n > d_.size()) throw std::runtime_error("pickle: truncated");
+    std::memcpy(dst, d_.data() + pos_, n);
+    pos_ += n;
+  }
+  std::string ReadStr(uint64_t n) {
+    if (pos_ + n > d_.size()) throw std::runtime_error("pickle: truncated");
+    std::string s = d_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  void Push(Value v) { stack_.push_back(std::move(v)); }
+  Value Pop() {
+    Value v = std::move(stack_.back());
+    stack_.pop_back();
+    return v;
+  }
+  size_t PopMark() {
+    size_t m = marks_.back();
+    marks_.pop_back();
+    return m;
+  }
+  ValueDict MakeDict(size_t from) {
+    ValueDict d;
+    for (size_t i = from; i + 1 < stack_.size(); i += 2)
+      d[stack_[i].as_str()] = stack_[i + 1];
+    stack_.resize(from);
+    return d;
+  }
+
+  const std::string& d_;
+  size_t pos_ = 0;
+  ValueList stack_;
+  ValueList memo_;
+  std::vector<size_t> marks_;
+};
+
+inline Value Decode(const std::string& data) { return Decoder(data).Parse(); }
+
+}  // namespace pickle
+}  // namespace ray_tpu

@@ -2210,6 +2210,14 @@ class CoreWorker:
         tls = getattr(self, "_log_job_tls", None)
         if tls is not None:
             tls.job = spec.get("job_id")
+            # fallback for prints from threads the USER's task spawned
+            # (they have no tls entry): attribute to the worker's most
+            # recent job rather than dropping the lines. Known
+            # limitations vs the reference's file tailer: fd-level
+            # writes (subprocesses, native code) reach the session log
+            # file but not the stream; between-task prints attribute to
+            # the previous job.
+            self._log_last_job = spec.get("job_id")
 
     # -- worker side: tee stdout/stderr, publish job-tagged lines ------
     def _install_log_tee(self):
@@ -2228,7 +2236,8 @@ class CoreWorker:
         sys.stderr = _LogTee(sys.stderr, self)
 
     def _append_log_line(self, line: str):
-        job = getattr(self._log_job_tls, "job", None)
+        job = getattr(self._log_job_tls, "job", None) \
+            or getattr(self, "_log_last_job", None)
         with self._log_buf_lock:
             if len(self._log_buf) < 10000:
                 self._log_buf.append((job, line))

@@ -421,12 +421,13 @@ class Raylet:
         return [c for c in range(int(self.total.get("TPU", 0)))
                 if c not in held]
 
-    def _evict_idle_tpu_workers(self):
+    async def _evict_idle_tpu_workers(self):
         """Terminate idle chip-holding workers so their chips can be
         re-pinned (they keep libtpu ownership while pooled), waiting
-        for the processes to actually exit — libtpu releases its
-        device locks at teardown, so re-pinning before exit would race
-        the old owner."""
+        OFF the event loop for the processes to actually exit — libtpu
+        releases its device locks at teardown, so re-pinning before
+        exit would race the old owner, and blocking the loop would
+        stall heartbeats past the GCS death threshold."""
         victims = []
         for (tpu, env_key), pool in list(self._idle_workers.items()):
             if not tpu:
@@ -443,15 +444,21 @@ class Raylet:
                     pass
                 self._workers.pop(wid, None)
                 victims.append(h.proc)
-        deadline = time.time() + 5.0
-        for proc in victims:
-            try:
-                proc.wait(max(0.1, deadline - time.time()))
-            except Exception:
+
+        def _reap():
+            deadline = time.time() + 5.0
+            for proc in victims:
                 try:
-                    proc.kill()
+                    proc.wait(max(0.1, deadline - time.time()))
                 except Exception:
-                    pass
+                    try:
+                        proc.kill()
+                        proc.wait(2.0)
+                    except Exception:
+                        pass
+
+        if victims:
+            await asyncio.get_running_loop().run_in_executor(None, _reap)
 
     def _spawn_worker(self, tpu: int = 0,
                       runtime_env: Optional[dict] = None) -> _WorkerHandle:
@@ -478,17 +485,10 @@ class Raylet:
             # that sees exactly k chips (reference: TPU_VISIBLE_CHIPS
             # isolation, accelerators/tpu.py:32-41). Only set when a
             # proper subset is requested — whole-host workers keep the
-            # runtime's own numbering. IDLE workers keep libtpu
-            # ownership of their chips, so when free ids don't cover
-            # the request, evict idle TPU workers first; an unpinned
-            # worker next to pinned ones would fight over devices.
-            # (tpu < 0 = fractional/shared demand: TPU runtime with no
-            # pinning — exclusivity was already waived by the user.)
+            # runtime's own numbering. (Idle chip-holders were evicted
+            # by the caller, _grant_lease, before spawning.)
             total_chips = int(self.total.get("TPU", 0))
             free = self._free_chip_ids()
-            if len(free) < (tpu if tpu < total_chips else total_chips):
-                self._evict_idle_tpu_workers()
-                free = self._free_chip_ids()
             if 0 < tpu < total_chips:
                 if len(free) < tpu:
                     raise RuntimeError(
@@ -741,24 +741,33 @@ class Raylet:
 
     async def _grant_lease(self, demand, pg_key, lease_type,
                            runtime_env: Optional[dict] = None):
-        # Whole-chip demands pin TPU_VISIBLE_CHIPS subsets; FRACTIONAL
-        # demands (admitted by resource accounting, e.g. two TPU:0.5
-        # tasks on one chip) share unpinned TPU workers instead — a
-        # fractional lease must never hard-fail on chip exclusivity.
+        # Whole-chip demands pin TPU_VISIBLE_CHIPS subsets. FRACTIONAL
+        # TPU demands are rejected loudly: libtpu is single-owner per
+        # chip, so two processes cannot actually share one — silently
+        # granting an unpinned worker would double-claim devices (the
+        # reference's TPU accelerator manager is also whole-chip:
+        # accelerators/tpu.py partitions by integer chip ids).
         tpu_chips = 0
-        fractional = False
         for k, v in demand.items():
             if (k == "TPU" or k.startswith("TPU-")) and v > 0:
                 if v != int(v):
-                    fractional = True
+                    return {"ok": False, "spill_to": None,
+                            "infeasible": False,
+                            "fatal": (
+                                f"fractional TPU demand {k}={v} is not "
+                                "supported: TPU chips are process-"
+                                "exclusive (libtpu single-owner); "
+                                "request whole chips")}
                 tpu_chips = max(tpu_chips, int(v))
-        if fractional or (tpu_chips == 0 and any(
-            (k == "TPU" or k.startswith("TPU-")) and v > 0
-            for k, v in demand.items()
-        )):
-            tpu_chips = -1  # TPU runtime, no chip pinning (shared pool)
         env_key = self._runtime_env_key(runtime_env)
         worker = await self._pop_worker(tpu_chips, env_key)
+        if worker is None and tpu_chips > 0:
+            # idle workers keep libtpu ownership of their chips; evict
+            # (and await exit) before pinning a fresh subset
+            total_chips = int(self.total.get("TPU", 0))
+            need = min(tpu_chips, total_chips)
+            if len(self._free_chip_ids()) < need:
+                await self._evict_idle_tpu_workers()
         if worker is None:
             try:
                 worker = self._spawn_worker(tpu=tpu_chips,

@@ -1,0 +1,37 @@
+"""Cross-language function registry: call Python by name from C++.
+
+Reference: python/ray/cross_language.py — the reference invokes across
+languages through function descriptors (module/class/function names)
+rather than pickled code, since the caller can't pickle the callee's
+language. Here a Python process registers ``name -> fn`` in the GCS KV;
+a C++ ClientSession (cpp/include/ray_tpu/client.h) submits tasks by
+name with a bytes payload through the Ray Client server.
+
+Contract: ``fn(payload: bytes) -> bytes`` — byte strings are the only
+type both languages agree on without a schema layer.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import cloudpickle
+
+_NS = "crosslang"
+
+
+def register_function(name: str, fn: Callable[[bytes], bytes]) -> None:
+    """Register fn under ``name`` for by-name invocation (any language).
+    Must be called from a cluster-connected process."""
+    from ._private.core_worker import global_worker
+
+    global_worker().gcs.kv_put(
+        ns=_NS, key=name, value=cloudpickle.dumps(fn))
+
+
+def get_function(name: str) -> Callable[[bytes], bytes]:
+    from ._private.core_worker import global_worker
+
+    blob = global_worker().gcs.kv_get(ns=_NS, key=name)
+    if blob is None:
+        raise KeyError(f"no cross-language function registered as {name!r}")
+    return cloudpickle.loads(blob)

@@ -430,11 +430,30 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, res, do):
 _flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
 
 
+def _pick_block(n: int, pref: int):
+    """Largest power-of-two block <= pref that divides n. Blocks MUST
+    divide the sequence: a padded tail block would feed undefined OOB
+    lse/delta into the backward accumulators (padded rows pass the
+    causal mask, contaminating VALID dk/dv rows)."""
+    b = min(pref, n)
+    while b >= 8:
+        if n % b == 0:
+            return b
+        b //= 2
+    return None
+
+
 def _flash_attention_pallas(q, k, v, causal: bool, scale: float,
                             block_q: int = _BLOCK_Q,
                             block_k: int = _BLOCK_K):
     B, S, H, D = q.shape
     T = k.shape[1]
+    block_q = _pick_block(S, block_q)
+    block_k = _pick_block(T, block_k)
+    if block_q is None or block_k is None:
+        raise ValueError(
+            f"sequence lengths ({S}, {T}) have no power-of-two block "
+            ">= 8; portable attention will be used")
     k = _repeat_kv(k, H // k.shape[2])
     v = _repeat_kv(v, H // v.shape[2])
     # [B,S,H,D] -> [B*H, S, D]: flattened-head grid (GQA expansion and

@@ -283,6 +283,49 @@ class ClientServer:
         await self._in_thread(ray.kill, handle, no_restart=no_restart)
         return True
 
+    # -- cross-language surface (bytes in/out; consumed by the C++
+    #    worker API, cpp/include/ray_tpu/client.h) ---------------------
+    async def client_put_bytes(self, session_id: str,
+                               payload: bytes) -> str:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        ref = await self._in_thread(ray.put, payload)
+        return self._track(sess, ref)[0]
+
+    async def client_get_bytes(self, session_id: str, ref_id: str,
+                               get_timeout: Optional[float] = None
+                               ) -> bytes:
+        import ray_tpu as ray
+
+        sess = self._session(session_id)
+        value = await self._in_thread(
+            ray.get, sess.refs[ref_id], timeout=get_timeout)
+        if not isinstance(value, (bytes, bytearray)):
+            raise TypeError(
+                f"cross-language results must be bytes, got "
+                f"{type(value).__name__}")
+        return bytes(value)
+
+    async def client_task_by_name(self, session_id: str, func_name: str,
+                                  payload: bytes) -> str:
+        """Submit a registered cross-language function by name
+        (reference: function-descriptor invocation,
+        python/ray/cross_language.py)."""
+        import ray_tpu as ray
+        from ... import cross_language
+
+        sess = self._session(session_id)
+        cache_key = f"__crosslang__:{func_name}"
+        fn = sess.funcs.get(cache_key)
+        if fn is None:
+            raw = await self._in_thread(
+                cross_language.get_function, func_name)
+            fn = ray.remote(raw)
+            sess.funcs[cache_key] = fn
+        ref = await self._in_thread(fn.remote, payload)
+        return self._track(sess, ref)[0]
+
     async def client_api(self, session_id: str, api_method: str) -> Any:
         """Read-only cluster info passthrough."""
         import ray_tpu as ray

@@ -83,12 +83,9 @@ def test_detection_from_device_files(monkeypatch):
     assert labels["tpu-accelerator-type"] == "unknown"
 
 
-def test_fractional_tpu_demand_shares_chips(tpu_cluster):
-    # two TPU:0.5 leases fit one chip's accounting; neither may pin —
-    # they run in shared unpinned workers rather than hard-failing
-    refs = [
-        visible_chips.options(resources={"TPU": 0.5}).remote()
-        for _ in range(2)
-    ]
-    a, b = ray.get(refs, timeout=120)
-    assert a == "" and b == ""
+def test_fractional_tpu_demand_rejected(tpu_cluster):
+    # chips are process-exclusive (libtpu single-owner): fractional TPU
+    # demands fail loudly instead of silently double-claiming devices
+    ref = visible_chips.options(resources={"TPU": 0.5}).remote()
+    with pytest.raises(Exception, match="fractional TPU"):
+        ray.get(ref, timeout=120)

@@ -1,0 +1,70 @@
+"""C++ worker API tests: compile the example against the live cluster.
+
+Reference analogues: cpp/src/ray/test/cluster/cluster_mode_test.cc —
+the reference CI builds and runs C++ clients against a real cluster.
+Here the example exercises the pickle codec, the RPC framing, the
+cross-language by-name call path, and zero-copy shm interop.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import cross_language
+from ray_tpu.util.client import ClientServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cpp_binary(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("cppbuild") / "cross_lang")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-I", os.path.join(REPO, "cpp/include"),
+         os.path.join(REPO, "cpp/examples/cross_lang.cc"), "-o", out,
+         "-ldl", "-pthread"],
+        check=True, capture_output=True, text=True,
+    )
+    return out
+
+
+@pytest.fixture(scope="module")
+def cluster_with_client_server():
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    cross_language.register_function(
+        "cpp_echo", lambda payload: b"echo:" + payload)
+    srv = ClientServer(port=0)
+    yield srv
+    srv.stop()
+    ray.shutdown()
+
+
+def test_pickle_codec_roundtrip(cpp_binary):
+    """The binary existing proves the header-only codec compiles; the
+    wire-level round trip is covered by the e2e test below."""
+    assert os.path.exists(cpp_binary)
+
+
+def test_cpp_client_end_to_end(cpp_binary, cluster_with_client_server):
+    import ray_tpu.api as api
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.native.build import ensure_built
+
+    # seal a raw object with a known id for the zero-copy check
+    w = api.global_worker()
+    oid = ObjectID(b"cpp_interop_test" + b"\x00" * 4)
+    w.store.put_raw(oid, b"zero-copy-from-python")
+
+    srv = cluster_with_client_server
+    proc = subprocess.run(
+        [cpp_binary, srv.address[0], str(srv.address[1]),
+         w.store.path, ensure_built()],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "put/get: hello from c++" in proc.stdout
+    assert "cpp_echo -> echo:ping-42" in proc.stdout
+    assert "shm object" in proc.stdout
+    assert "CPP_WORKER_OK" in proc.stdout
