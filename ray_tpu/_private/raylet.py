@@ -221,6 +221,8 @@ class Raylet:
         # autoscaler feed: when this node last became fully idle (all
         # resources free, nothing queued). 0.0 = currently busy.
         self._node_idle_since: float = time.time()
+        # serializes TPU chip eviction + pinning (see _grant_lease)
+        self._chip_grant_lock = asyncio.Lock()
         # recently-seen infeasible shapes (shape-tuple -> last ts)
         self._infeasible_demand: Dict[tuple, float] = {}
 
@@ -698,6 +700,20 @@ class Raylet:
         """
         pg_key = self._bundle_key(placement_group_id, bundle_index)
         demand = {k: float(v) for k, v in (demand or {}).items()}
+        # Validate BEFORE acquiring resources: a rejection after
+        # _try_acquire would have to unwind the accounting. Fractional
+        # TPU demands are unsupported — libtpu is single-owner per chip
+        # (reference: accelerators/tpu.py partitions by whole chip ids).
+        for k, v in demand.items():
+            if (k == "TPU" or k.startswith("TPU-")) and v > 0 \
+                    and v != int(v):
+                return {"ok": False, "spill_to": None,
+                        "infeasible": False,
+                        "fatal": (
+                            f"fractional TPU demand {k}={v} is not "
+                            "supported: TPU chips are process-exclusive "
+                            "(libtpu single-owner); request whole "
+                            "chips")}
 
         if pg_key is not None and not any(
             k[0] == pg_key[0] for k in self._bundles
@@ -741,33 +757,37 @@ class Raylet:
 
     async def _grant_lease(self, demand, pg_key, lease_type,
                            runtime_env: Optional[dict] = None):
-        # Whole-chip demands pin TPU_VISIBLE_CHIPS subsets. FRACTIONAL
-        # TPU demands are rejected loudly: libtpu is single-owner per
-        # chip, so two processes cannot actually share one — silently
-        # granting an unpinned worker would double-claim devices (the
-        # reference's TPU accelerator manager is also whole-chip:
-        # accelerators/tpu.py partitions by integer chip ids).
+        # Whole-chip demands pin TPU_VISIBLE_CHIPS subsets (fractional
+        # demands were rejected up front in lease_worker).
         tpu_chips = 0
         for k, v in demand.items():
             if (k == "TPU" or k.startswith("TPU-")) and v > 0:
-                if v != int(v):
-                    return {"ok": False, "spill_to": None,
-                            "infeasible": False,
-                            "fatal": (
-                                f"fractional TPU demand {k}={v} is not "
-                                "supported: TPU chips are process-"
-                                "exclusive (libtpu single-owner); "
-                                "request whole chips")}
                 tpu_chips = max(tpu_chips, int(v))
         env_key = self._runtime_env_key(runtime_env)
+        if tpu_chips > 0:
+            # chip grants serialize: eviction awaits process exit, and a
+            # concurrent grant running between "victims removed from
+            # bookkeeping" and "victims actually exited" would pin chips
+            # the dying libtpu owners still hold
+            async with self._chip_grant_lock:
+                worker = await self._pop_worker(tpu_chips, env_key)
+                if worker is None:
+                    total_chips = int(self.total.get("TPU", 0))
+                    need = min(tpu_chips, total_chips)
+                    if len(self._free_chip_ids()) < need:
+                        await self._evict_idle_tpu_workers()
+                    try:
+                        worker = self._spawn_worker(
+                            tpu=tpu_chips, runtime_env=runtime_env)
+                    except Exception as e:
+                        self._release_after_grant(demand, pg_key)
+                        return {"ok": False, "spill_to": None,
+                                "infeasible": False,
+                                "fatal": f"worker spawn failed: {e}"}
+                worker.reserved = True
+            return await self._finish_grant(worker, demand, pg_key,
+                                            lease_type)
         worker = await self._pop_worker(tpu_chips, env_key)
-        if worker is None and tpu_chips > 0:
-            # idle workers keep libtpu ownership of their chips; evict
-            # (and await exit) before pinning a fresh subset
-            total_chips = int(self.total.get("TPU", 0))
-            need = min(tpu_chips, total_chips)
-            if len(self._free_chip_ids()) < need:
-                await self._evict_idle_tpu_workers()
         if worker is None:
             try:
                 worker = self._spawn_worker(tpu=tpu_chips,
@@ -778,6 +798,10 @@ class Raylet:
                         "infeasible": False,
                         "fatal": f"worker spawn failed: {e}"}
         worker.reserved = True
+        return await self._finish_grant(worker, demand, pg_key,
+                                        lease_type)
+
+    async def _finish_grant(self, worker, demand, pg_key, lease_type):
         try:
             await asyncio.wait_for(
                 worker.registered.wait(), self._cfg.worker_register_timeout_s

@@ -42,6 +42,13 @@ class EngineConfig:
     kv_layout: str = "slab"
     page_size: int = 32
     num_pages: int = 0  # 0 = max_batch_size * max_seq_len / page_size
+    # decode steps per scheduler tick with ON-DEVICE sampling: the token
+    # feeds back through a lax.scan without a host round-trip, so per
+    # tick only the token ids transfer (vLLM's multi-step scheduling).
+    # Tokens generated past a request's stop are discarded host-side;
+    # requests needing host sampling (top_k, per-request seed) fall
+    # back to single-step ticks. 1 disables.
+    decode_chunk: int = 8
 
     def effective_prefill_buckets(self) -> tuple:
         """Paged layouts admit only page-aligned buckets; prefill
@@ -154,6 +161,58 @@ class LLMEngine:
 
         if not self.paged:
             self._decode = jax.jit(decode_step, donate_argnums=(1,))
+
+        # multi-step decode: `chunk` tokens per dispatch, sampling
+        # (greedy / temperature) on device inside the scan
+        chunk = max(1, self.ecfg.decode_chunk)
+
+        def _sample_on_device(logits, temps, key):
+            greedy = jnp.argmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(key, scaled)
+            return jnp.where(temps <= 0.0, greedy,
+                             sampled).astype(jnp.int32)
+
+        if chunk > 1 and not self.paged:
+            def decode_multi(params, cache, tokens, lengths, active,
+                             temps, key):
+                def step(carry, k):
+                    cache, toks, lens = carry
+                    logits, cache = forward_cached(
+                        cfg, params, toks, cache, lens)
+                    tok = _sample_on_device(logits[:, -1, :], temps, k)
+                    lens = lens + active
+                    return (cache, tok[:, None], lens), tok
+
+                keys = jax.random.split(key, chunk)
+                (cache, _, _), toks = jax.lax.scan(
+                    step, (cache, tokens, lengths), keys)
+                return toks, cache  # toks [chunk, B]
+
+            self._decode_multi = jax.jit(decode_multi,
+                                         donate_argnums=(1,))
+        if chunk > 1 and self.paged:
+            from ..models.llama import forward_paged_decode as _fpd
+
+            def decode_multi_paged(params, pages, tokens, page_tables,
+                                   lengths, active, temps, key):
+                def step(carry, k):
+                    pages, toks, lens = carry
+                    logits, pages = _fpd(
+                        cfg, params, toks, pages, page_tables, lens)
+                    tok = _sample_on_device(logits, temps, k)
+                    lens = lens + active
+                    return (pages, tok[:, None], lens), tok
+
+                keys = jax.random.split(key, chunk)
+                (pages, _, _), toks = jax.lax.scan(
+                    step, (pages, tokens, lengths), keys)
+                return toks, pages
+
+            self._decode_multi_paged = jax.jit(decode_multi_paged,
+                                               donate_argnums=(1,))
+        self._sample_base_key = jax.random.PRNGKey(seed ^ 0x5EED)
+        self._tick_counter = 0
 
         # prefill per bucket, single slot (both layouts)
         def prefill(params, cache1, tokens, true_len):
@@ -313,7 +372,6 @@ class LLMEngine:
                 if not admitted:
                     time.sleep(0.002)
                 return
-            # one batched decode step for every active slot
             last_tokens = np.zeros(
                 (self.ecfg.max_batch_size, 1), dtype=np.int32
             )
@@ -322,6 +380,22 @@ class LLMEngine:
                 last_tokens[i, 0] = (
                     req.generated[-1] if req.generated else req.prompt[-1]
                 )
+            chunk = max(1, self.ecfg.decode_chunk)
+            use_multi = (
+                chunk > 1
+                and all(
+                    self.slots[i].params.top_k in (0, None)
+                    and self.slots[i].params.seed is None
+                    for i in active
+                )
+                # overshoot inside the chunk must stay within bounds
+                and int(self.lengths[active].max()) + chunk
+                < self.ecfg.max_seq_len
+            )
+            if use_multi:
+                self._decode_chunk(jnp, active, last_tokens, chunk)
+                return
+            # single batched decode step for every active slot
             if self.paged:
                 logits, self.pages = self._decode_paged(
                     self.params,
@@ -347,6 +421,48 @@ class LLMEngine:
                 if req.first_token_time is None:
                     req.first_token_time = now
                 self._maybe_finish(i)
+
+    def _decode_chunk(self, jnp, active, last_tokens, chunk):
+        """Multi-step decode: `chunk` tokens in ONE dispatch, sampling
+        on device; only the token ids cross to the host. Tokens past a
+        request's stop are discarded (the cache positions they wrote
+        are beyond the request's final length and are never read)."""
+        jax = self._jax
+        B = self.ecfg.max_batch_size
+        active_mask = np.zeros(B, dtype=np.int32)
+        active_mask[active] = 1
+        temps = np.zeros(B, dtype=np.float32)
+        for i in active:
+            temps[i] = self.slots[i].params.temperature
+        self._tick_counter += 1
+        key = jax.random.fold_in(self._sample_base_key,
+                                 self._tick_counter)
+        if self.paged:
+            toks, self.pages = self._decode_multi_paged(
+                self.params, self.pages, jnp.asarray(last_tokens),
+                jnp.asarray(self.page_tables), jnp.asarray(self.lengths),
+                jnp.asarray(active_mask), jnp.asarray(temps), key,
+            )
+        else:
+            toks, self.cache = self._decode_multi(
+                self.params, self.cache, jnp.asarray(last_tokens),
+                jnp.asarray(self.lengths), jnp.asarray(active_mask),
+                jnp.asarray(temps), key,
+            )
+        toks_np = np.asarray(toks)  # [chunk, B]
+        now = time.time()
+        for i in active:
+            req = self.slots[i]
+            consumed = 0
+            for step in range(chunk):
+                req.generated.append(int(toks_np[step, i]))
+                consumed += 1
+                if req.first_token_time is None:
+                    req.first_token_time = now
+                if self._is_finished(req):
+                    break
+            self.lengths[i] += consumed
+            self._maybe_finish(i)
 
     def _admit(self) -> bool:
         jnp = self._jnp
@@ -482,13 +598,22 @@ class LLMEngine:
         p /= p.sum()
         return int(self._rng.choice(len(p), p=p))
 
+    def _is_finished(self, req: "_Request") -> bool:
+        return bool(
+            (req.generated
+             and req.generated[-1] in req.params.stop_token_ids)
+            or len(req.generated) >= req.params.max_tokens
+        )
+
     def _maybe_finish(self, i: int):
         req = self.slots[i]
         reason = None
-        if req.generated and req.generated[-1] in req.params.stop_token_ids:
-            reason = "stop"
-        elif len(req.generated) >= req.params.max_tokens:
-            reason = "length"
+        if self._is_finished(req):
+            reason = (
+                "stop"
+                if req.generated[-1] in req.params.stop_token_ids
+                else "length"
+            )
         elif self.lengths[i] + 1 >= self.ecfg.max_seq_len:
             reason = "max_seq_len"
         if reason is None:
