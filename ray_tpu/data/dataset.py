@@ -17,7 +17,10 @@ import ray_tpu as ray
 from .block import BlockAccessor, batch_to_block, rows_to_block
 from .context import DataContext
 from .executor import StreamingExecutor, _meta_of
-from .plan import AllToAll, InputBlocks, Limit, LogicalPlan, MapBlocks, Read, Union
+from .plan import (
+    AllToAll, InputBlocks, Join, Limit, LogicalPlan, MapBlocks, Read,
+    Union, Zip,
+)
 
 
 def _batch_transform(fn, batch_format, batch_size):
@@ -138,6 +141,62 @@ class Dataset:
         return Dataset(self._plan.with_op(Union(
             name="Union", others=[o._plan for o in others]
         )))
+
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             right_suffix: str = "_right") -> "Dataset":
+        """Hash join on a key column (reference: Dataset.join,
+        data/_internal/execution/operators/join.py). how: inner|left."""
+        if how not in ("inner", "left"):
+            raise ValueError(f"unsupported join how={how!r}")
+        return Dataset(self._plan.with_op(Join(
+            name=f"Join[{on}]", other=other._plan, on=on, how=how,
+            right_suffix=right_suffix,
+        )))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Pair rows positionally; row counts must match (reference:
+        Dataset.zip)."""
+        return Dataset(self._plan.with_op(Zip(
+            name="Zip", other=other._plan,
+        )))
+
+    # ------------------------------------------------------------------
+    # column ops (map-based; reference: Dataset.add_column etc.)
+    # ------------------------------------------------------------------
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        cols = list(cols)
+        return self.map(lambda r: {c: r[c] for c in cols})
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        drop = set(cols)
+        return self.map(
+            lambda r: {k: v for k, v in r.items() if k not in drop})
+
+    def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
+        return self.map(lambda r: {**r, name: fn(r)})
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map(
+            lambda r: {mapping.get(k, k): v for k, v in r.items()})
+
+    def unique(self, col: str) -> List[Any]:
+        """Distinct values of a column (executes)."""
+        rows = self.groupby(col).count().take_all()
+        return sorted((r[col] for r in rows), key=repr)
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli sample — deterministic per row content when seeded
+        (a per-task rng would resample differently on retries)."""
+        import zlib
+
+        salt = 0 if seed is None else seed
+
+        def keep(r):
+            h = zlib.crc32(repr(sorted(r.items())).encode()) ^ salt
+            return (h % (1 << 20)) / float(1 << 20) < fraction
+
+        return self.filter(keep)
 
     # ------------------------------------------------------------------
     # consumption (triggers execution)
@@ -275,3 +334,6 @@ class GroupedDataset:
 
     def max(self, col: str) -> Dataset:
         return self._agg([(f"max({col})", col, "max")])
+
+    def std(self, col: str) -> Dataset:
+        return self._agg([(f"std({col})", col, "std")])

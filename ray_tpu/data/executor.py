@@ -21,7 +21,10 @@ import ray_tpu as ray
 
 from .block import BlockAccessor, rows_to_block
 from .context import DataContext
-from .plan import AllToAll, InputBlocks, Limit, LogicalPlan, MapBlocks, Read, Union
+from .plan import (
+    AllToAll, InputBlocks, Join, Limit, LogicalPlan, MapBlocks, Read,
+    Union, Zip,
+)
 
 Meta = dict
 RefMeta = Tuple[Any, Meta]  # (ObjectRef -> Block, metadata)
@@ -80,6 +83,10 @@ class StreamingExecutor:
                 stream = self._all_to_all_stage(op, stream)
             elif isinstance(op, Union):
                 stream = self._union_stage(op, stream)
+            elif isinstance(op, Join):
+                stream = self._join_stage(op, stream)
+            elif isinstance(op, Zip):
+                stream = self._zip_stage(op, stream)
             else:
                 raise TypeError(f"unknown logical op {op}")
         return stream
@@ -155,6 +162,103 @@ class StreamingExecutor:
         yield from upstream
         for other in op.others:
             yield from self.execute(other)
+
+    def _join_stage(self, op: Join, upstream) -> Iterator[RefMeta]:
+        """Hash join: both sides shard by crc32(key) into P partitions,
+        one join task per partition (reference: hash-shuffle join,
+        data/_internal/execution/operators/join.py)."""
+        left = list(upstream)
+        right = list(self.execute(op.other))
+        P = max(1, min(max(len(left), len(right)), 8))
+        key, how, suffix = op.on, op.how, op.right_suffix
+
+        def shard_task(block, n):
+            import zlib
+
+            shards: List[List[Any]] = [[] for _ in range(n)]
+            for r in BlockAccessor.for_block(block).iter_rows():
+                h = zlib.crc32(repr(r[key]).encode())
+                shards[h % n].append(r)
+            return [
+                (lambda b: (ray.put(b), _meta_of(b)))(rows_to_block(s))
+                for s in shards
+            ]
+
+        shard = ray.remote(shard_task)
+        # submit the whole map side first, THEN gather: the shard tasks
+        # run in parallel across the cluster
+        left_futs = [shard.remote(ref, P) for ref, _m in left]
+        right_futs = [shard.remote(ref, P) for ref, _m in right]
+        left_parts = [ray.get(f, timeout=600) for f in left_futs]
+        right_parts = [ray.get(f, timeout=600) for f in right_futs]
+
+        def join_task(n_left, *shards):
+            build: dict = {}
+            for s in shards[n_left:]:
+                for r in BlockAccessor.for_block(s).iter_rows():
+                    build.setdefault(r[key], []).append(r)
+            out = []
+            for s in shards[:n_left]:
+                for l in BlockAccessor.for_block(s).iter_rows():
+                    matches = build.get(l[key], ())
+                    if matches:
+                        for r in matches:
+                            row = dict(l)
+                            for ck, cv in r.items():
+                                if ck == key:
+                                    continue
+                                row[ck + suffix if ck in row else ck] = cv
+                            out.append(row)
+                    elif how == "left":
+                        out.append(dict(l))
+            b = rows_to_block(out)
+            return (ray.put(b), _meta_of(b))
+
+        join = ray.remote(join_task)
+        futures = []
+        for p in range(P):
+            l_shards = [parts[p][0] for parts in left_parts]
+            r_shards = [parts[p][0] for parts in right_parts]
+            futures.append(
+                join.remote(len(l_shards), *l_shards, *r_shards))
+        for fut in futures:
+            yield ray.get(fut)
+
+    def _zip_stage(self, op: Zip, upstream) -> Iterator[RefMeta]:
+        """Positional zip: pairs the i-th row of each side (row counts
+        must match). Runs as one task over the collected blocks —
+        correctness first; blockwise alignment is an optimization the
+        reference also only applies when block shapes already agree."""
+        left = [ref for ref, _m in upstream]
+        right = [ref for ref, _m in self.execute(op.other)]
+
+        def zip_task(n_left, *blocks):
+            def rows(bs):
+                for b in bs:
+                    yield from BlockAccessor.for_block(b).iter_rows()
+
+            sentinel = object()
+            out = []
+            li, ri = rows(blocks[:n_left]), rows(blocks[n_left:])
+            while True:
+                l = next(li, sentinel)
+                r = next(ri, sentinel)
+                if l is sentinel and r is sentinel:
+                    break
+                if l is sentinel or r is sentinel:
+                    # row-count mismatch is a user error, not silent
+                    # truncation
+                    side = "right" if l is sentinel else "left"
+                    raise ValueError(f"zip: {side} side has more rows")
+                row = dict(l)
+                for ck, cv in r.items():
+                    row[ck + "_1" if ck in row else ck] = cv
+                out.append(row)
+            b = rows_to_block(out)
+            return (ray.put(b), _meta_of(b))
+
+        fut = ray.remote(zip_task).remote(len(left), *left, *right)
+        yield ray.get(fut)
 
     # ------------------------------------------------------------------
     # all-to-all exchanges (barrier; reference: planner/exchange/)
@@ -350,11 +454,19 @@ class StreamingExecutor:
                         row[name] = len(rows)
                     else:
                         vals = [r[col] for r in rows]
+                        mean = sum(vals) / len(vals)
                         row[name] = {
                             "sum": sum(vals),
                             "min": min(vals),
                             "max": max(vals),
-                            "mean": sum(vals) / len(vals),
+                            "mean": mean,
+                            # sample std (ddof=1), matching the
+                            # reference Dataset API default
+                            "std": (
+                                (sum((v - mean) ** 2 for v in vals)
+                                 / (len(vals) - 1)) ** 0.5
+                                if len(vals) > 1 else 0.0
+                            ),
                         }[fn]
                 out_rows.append(row)
             b = rows_to_block(out_rows)

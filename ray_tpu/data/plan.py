@@ -55,6 +55,24 @@ class Union(LogicalOp):
     others: List["LogicalPlan"]
 
 
+@dataclass
+class Join(LogicalOp):
+    """Hash join against another plan (reference:
+    data/_internal/execution/operators/join.py)."""
+
+    other: "LogicalPlan"
+    on: str
+    how: str = "inner"  # inner | left
+    right_suffix: str = "_right"
+
+
+@dataclass
+class Zip(LogicalOp):
+    """Positional zip with another plan (reference: Dataset.zip)."""
+
+    other: "LogicalPlan"
+
+
 class LogicalPlan:
     def __init__(self, ops: List[LogicalOp]):
         self.ops = ops

@@ -19,3 +19,7 @@ from .api import (  # noqa: F401
 )
 from .batching import batch  # noqa: F401
 from .handle import DeploymentHandle  # noqa: F401
+from .multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)

@@ -20,6 +20,7 @@ class _Router:
         self.controller = controller_handle
         self._replicas: List[Any] = []  # ActorHandle list
         self._inflight: Dict[str, int] = {}
+        self._models: Dict[str, set] = {}  # actor_id -> loaded models
         self._last_refresh = 0.0
         self._lock = threading.Lock()
 
@@ -37,15 +38,36 @@ class _Router:
             self.controller.get_replicas.remote(name=self.name), timeout=30
         )
         methods = _public_methods(ReplicaActor)
+        replicas = [ActorHandle(aid, methods) for aid in actor_ids]
+        # model-aware routing needs each replica's loaded-model set
+        # (reference: multiplex-aware pow-2 router); fetched
+        # CONCURRENTLY and best-effort — one hung replica costs one
+        # shared 5s window, not 5s each, and just loses its preference
+        models: Dict[str, set] = {r.actor_id: set() for r in replicas}
+        refs = [(r.actor_id, r.get_stats.remote()) for r in replicas]
+        ready, _pending = ray.wait(
+            [ref for _a, ref in refs], num_returns=len(refs), timeout=5)
+        ready_set = {id(x) for x in ready}
+        for aid, ref in refs:
+            if id(ref) in ready_set:
+                try:
+                    stats = ray.get(ref, timeout=1)
+                    models[aid] = set(
+                        stats.get("multiplexed_model_ids", ()))
+                except Exception:
+                    pass
         with self._lock:
-            self._replicas = [ActorHandle(aid, methods) for aid in actor_ids]
+            self._replicas = replicas
+            self._models = models
             self._inflight = {
                 aid: self._inflight.get(aid, 0) for aid in actor_ids
             }
             self._last_refresh = now
 
-    def choose(self):
-        """Power-of-two-choices by locally tracked in-flight count."""
+    def choose(self, model_id: str = ""):
+        """Power-of-two-choices by locally tracked in-flight count;
+        multiplexed requests prefer replicas already holding the
+        model."""
         deadline = time.monotonic() + 30.0
         while True:
             self._refresh()
@@ -59,13 +81,30 @@ class _Router:
                 )
             time.sleep(0.1)
             self._last_refresh = 0.0
+        if model_id:
+            with self._lock:
+                holders = [
+                    r for r in reps
+                    if model_id in self._models.get(r.actor_id, ())
+                ]
+            if holders:
+                reps = holders
         if len(reps) == 1:
-            return reps[0]
-        a, b = random.sample(reps, 2)
-        with self._lock:
-            ia = self._inflight.get(a.actor_id, 0)
-            ib = self._inflight.get(b.actor_id, 0)
-        return a if ia <= ib else b
+            chosen = reps[0]
+        else:
+            a, b = random.sample(reps, 2)
+            with self._lock:
+                ia = self._inflight.get(a.actor_id, 0)
+                ib = self._inflight.get(b.actor_id, 0)
+            chosen = a if ia <= ib else b
+        if model_id:
+            # the chosen replica will load the model: record it locally
+            # so back-to-back requests inside the refresh window stick
+            # to it instead of scattering loads across the pool
+            with self._lock:
+                self._models.setdefault(chosen.actor_id, set()).add(
+                    model_id)
+        return chosen
 
     def track(self, actor_id: str, delta: int):
         with self._lock:
@@ -97,9 +136,11 @@ class _ResponseFuture:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._method = method_name
+        self._model_id = multiplexed_model_id
         self._router: Optional[_Router] = None
 
     def _get_router(self) -> _Router:
@@ -112,10 +153,21 @@ class DeploymentHandle:
             self._router = _Router(self.deployment_name, controller)
         return self._router
 
-    def options(self, method_name: Optional[str] = None) -> "DeploymentHandle":
-        return DeploymentHandle(
-            self.deployment_name, method_name or self._method
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        out = DeploymentHandle(
+            self.deployment_name, method_name or self._method,
+            multiplexed_model_id
+            if multiplexed_model_id is not None else self._model_id,
         )
+        # per-request .options() copies share the router: its in-flight
+        # accounting and model map must not reset per call (creating it
+        # here, not just passing a maybe-None field — a proxy that only
+        # ever calls .options().remote() would otherwise build a fresh
+        # router, with its discovery RPCs, per request)
+        out._router = self._get_router()
+        return out
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -124,12 +176,14 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs) -> _ResponseFuture:
         router = self._get_router()
-        replica = router.choose()
+        replica = router.choose(self._model_id)
         router.track(replica.actor_id, +1)
         ref = replica.handle_request.remote(
-            method=self._method, args=args, kwargs=kwargs
+            method=self._method, args=args, kwargs=kwargs,
+            multiplexed_model_id=self._model_id,
         )
         return _ResponseFuture(router, replica.actor_id, ref)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self._method))
+        return (DeploymentHandle,
+                (self.deployment_name, self._method, self._model_id))

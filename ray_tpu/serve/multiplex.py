@@ -1,0 +1,133 @@
+"""Model multiplexing: one replica pool hosts many models.
+
+Reference: python/ray/serve/multiplex.py (@serve.multiplexed wrapping a
+model loader with a per-replica LRU) + the multiplex-aware request
+router (request_router/pow_2_router.py prefers replicas that already
+hold the requested model). Callers pick the model per request with
+``handle.options(multiplexed_model_id=...)`` or the
+``serve_multiplexed_model_id`` HTTP header; inside the replica,
+``serve.get_multiplexed_model_id()`` returns the id for the current
+request.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import contextvars
+import inspect
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request being handled (reference:
+    serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_model_id(model_id: str):
+    _current_model_id.set(model_id or "")
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models; eviction calls __del__ (and
+    async teardown hooks are awaited when present)."""
+
+    def __init__(self, loader: Callable, max_models: int):
+        self.loader = loader
+        self.max_models = max_models
+        self.models: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._lock = asyncio.Lock()
+        self._loading: dict = {}  # model_id -> asyncio.Future
+
+    async def get(self, owner, model_id: str):
+        # Cache hits never wait behind a cold load; loads of the SAME
+        # id share one future (no double-load); loads of DIFFERENT ids
+        # may overlap — eviction keeps the resident count bounded.
+        async with self._lock:
+            if model_id in self.models:
+                self.models.move_to_end(model_id)
+                return self.models[model_id]
+            fut = self._loading.get(model_id)
+            if fut is None:
+                fut = asyncio.get_running_loop().create_future()
+                self._loading[model_id] = fut
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            return await asyncio.shield(fut)
+        try:
+            if inspect.iscoroutinefunction(self.loader):
+                model = await self.loader(owner, model_id)
+            else:
+                loop = asyncio.get_running_loop()
+                model = await loop.run_in_executor(
+                    None, lambda: self.loader(owner, model_id))
+        except Exception as e:
+            async with self._lock:
+                self._loading.pop(model_id, None)
+            fut.set_exception(e)
+            raise
+        async with self._lock:
+            while len(self.models) >= self.max_models:
+                _old_id, old = self.models.popitem(last=False)
+                del old
+            self.models[model_id] = model
+            self._loading.pop(model_id, None)
+        fut.set_result(model)
+        return model
+
+    def loaded_ids(self):
+        return list(self.models)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for a model-loader METHOD of a deployment class:
+
+        @serve.deployment
+        class Mux:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get_model(self, model_id: str):
+                return load(model_id)
+
+            async def __call__(self, req):
+                model = await self.get_model(
+                    serve.get_multiplexed_model_id())
+                ...
+    """
+
+    def decorate(loader):
+        cache_attr = f"__serve_mux_{loader.__name__}"
+
+        async def wrapper(self, model_id: str):
+            cache = getattr(self, cache_attr, None)
+            if cache is None:
+                cache = _ModelCache(loader,
+                                    max_num_models_per_replica)
+                setattr(self, cache_attr, cache)
+                # replica stats surface the loaded set for model-aware
+                # routing
+                caches = getattr(self, "__serve_mux_caches__", [])
+                caches.append(cache)
+                setattr(self, "__serve_mux_caches__", caches)
+            return await cache.get(self, model_id)
+
+        wrapper.__name__ = loader.__name__
+        wrapper.__wrapped__ = loader
+        return wrapper
+
+    if func is not None:
+        return decorate(func)
+    return decorate
+
+
+def loaded_model_ids(user_obj) -> list:
+    out = []
+    for cache in getattr(user_obj, "__serve_mux_caches__", []):
+        out.extend(cache.loaded_ids())
+    return out

@@ -166,6 +166,9 @@ class ProxyActor:
 
             handle = DeploymentHandle(target)
             self._handles[target] = handle
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
 
         try:
             # submission (routing + one actor push, may briefly block on

@@ -37,29 +37,38 @@ class ReplicaActor:
             thread_name_prefix="replica",
         )
 
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             multiplexed_model_id: str = ""):
         """Run a user method (sync methods hop to a thread; async run on
         the actor loop, interleaving like reference async replicas)."""
+        from .multiplex import _set_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        _set_model_id(multiplexed_model_id)
         try:
             target = getattr(self.user, method)
             if inspect.iscoroutinefunction(target):
                 return await target(*args, **kwargs)
             loop = asyncio.get_running_loop()
+            ctx = __import__("contextvars").copy_context()
             return await loop.run_in_executor(
-                self._executor, lambda: target(*args, **kwargs)
+                self._executor,
+                lambda: ctx.run(target, *args, **kwargs)
             )
         finally:
             with self._lock:
                 self._ongoing -= 1
 
     def get_stats(self) -> Dict[str, Any]:
+        from .multiplex import loaded_model_ids
+
         return {
             "ongoing": self._ongoing,
             "total": self._total,
             "uptime_s": time.time() - self._start,
+            "multiplexed_model_ids": loaded_model_ids(self.user),
         }
 
     def check_health(self) -> bool:

@@ -172,3 +172,78 @@ def test_from_items_preserves_order(ray_start):
     assert rd.from_items(list(range(20))).take(3) == [
         {"item": 0}, {"item": 1}, {"item": 2}
     ]
+
+
+# ---------------------------------------------------------------------------
+# joins / zip / column ops (reference: Dataset.join/zip/add_column tests)
+# ---------------------------------------------------------------------------
+def test_inner_join(ray_start):
+    users = rd.from_items([
+        {"uid": i, "name": f"u{i}"} for i in range(8)
+    ]).repartition(3)
+    orders = rd.from_items([
+        {"uid": i % 4, "amount": 10 * i} for i in range(12)
+    ]).repartition(2)
+    joined = users.join(orders, on="uid").take_all()
+    # only uids 0-3 have orders; 3 orders each
+    assert len(joined) == 12
+    assert all("name" in r and "amount" in r for r in joined)
+    assert {r["uid"] for r in joined} == {0, 1, 2, 3}
+
+
+def test_left_join_keeps_unmatched(ray_start):
+    left = rd.from_items([{"k": i, "a": i} for i in range(6)])
+    right = rd.from_items([{"k": i, "b": i * i} for i in range(3)])
+    out = left.join(right, on="k", how="left").take_all()
+    assert len(out) == 6
+    # unmatched rows carry a fill value (block schemas are unioned)
+    matched = [r for r in out if r.get("b") is not None]
+    assert {r["k"] for r in matched} == {0, 1, 2}
+
+
+def test_join_column_collision_gets_suffix(ray_start):
+    left = rd.from_items([{"k": 1, "v": "L"}])
+    right = rd.from_items([{"k": 1, "v": "R"}])
+    (row,) = left.join(right, on="k").take_all()
+    assert row["v"] == "L" and row["v_right"] == "R"
+
+
+def test_zip_positional(ray_start):
+    a = rd.from_items([{"x": i} for i in range(5)])
+    b = rd.from_items([{"y": i * 2} for i in range(5)])
+    out = a.zip(b).take_all()
+    assert [(r["x"], r["y"]) for r in out] == [(i, 2 * i) for i in range(5)]
+
+
+def test_zip_mismatched_lengths_raises(ray_start):
+    import pytest as _pytest
+
+    a = rd.from_items([{"x": i} for i in range(5)])
+    b = rd.from_items([{"y": i} for i in range(4)])
+    with _pytest.raises(Exception, match="more rows"):
+        a.zip(b).take_all()
+
+
+def test_column_ops_and_unique(ray_start):
+    ds = rd.from_items([
+        {"a": i, "b": i % 3, "c": -i} for i in range(9)
+    ])
+    out = ds.add_column("d", lambda r: r["a"] + r["c"]).take_all()
+    assert all(r["d"] == 0 for r in out)
+    out = ds.select_columns(["a"]).take(1)
+    assert set(out[0]) == {"a"}
+    out = ds.drop_columns(["c"]).take(1)
+    assert set(out[0]) == {"a", "b"}
+    out = ds.rename_columns({"a": "alpha"}).take(1)
+    assert "alpha" in out[0] and "a" not in out[0]
+    assert ds.unique("b") == [0, 1, 2]
+
+
+def test_random_sample_and_std(ray_start):
+    ds = rd.range(1000)
+    n = ds.random_sample(0.25, seed=7).count()
+    assert 150 < n < 350
+    (row,) = rd.from_items(
+        [{"g": 0, "v": v} for v in (2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0)]
+    ).groupby("g").std("v").take_all()
+    assert abs(row["std(v)"] - (32 / 7) ** 0.5) < 1e-9  # ddof=1

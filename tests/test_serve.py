@@ -165,3 +165,44 @@ def test_serve_batch(serve_start):
     assert sorted(results) == [i * 10 for i in range(8)]
     sizes = handle.get_batches.remote().result(timeout=30)
     assert max(sizes) > 1  # calls were actually coalesced
+
+
+# ---------------------------------------------------------------------------
+# model multiplexing (reference: serve/multiplex.py + multiplex-aware router)
+# ---------------------------------------------------------------------------
+def test_multiplexed_models(serve_start):
+
+    @serve.deployment(num_replicas=2)
+    class Mux:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "weight": len(model_id)}
+
+        async def __call__(self, payload):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model["id"], "loads": list(self.loads)}
+
+    serve.run(Mux.bind(), name="mux", route_prefix="/mux")
+    handle = serve.get_deployment_handle("Mux")
+
+    out = handle.options(multiplexed_model_id="m1").remote({}).result(60)
+    assert out["model"] == "m1"
+    # repeat requests for the same model route to a replica that has it
+    # loaded and never load twice on it
+    for _ in range(5):
+        out = handle.options(
+            multiplexed_model_id="m1").remote({}).result(60)
+        assert out["model"] == "m1"
+        assert out["loads"].count("m1") == 1
+    # LRU eviction: 3 distinct models with capacity 2 evicts the oldest
+    seen = set()
+    for mid in ("a", "b", "c", "a"):
+        out = handle.options(
+            multiplexed_model_id=mid).remote({}).result(60)
+        seen.add(out["model"])
+    assert seen == {"a", "b", "c"}
