@@ -416,6 +416,15 @@ void* shm_store_open(const char* path, uint64_t arena_size, int create) {
     h->clients_off = align_up(h->table_off + table_bytes);
     uint64_t clients_bytes = kMaxClients * sizeof(ClientSlot);
     uint64_t heap_off = align_up(h->clients_off + clients_bytes + 8);
+    if (heap_off + kAlign > arena_size) {
+      // metadata (size table + client ref ledgers) doesn't fit: an
+      // unsigned heap_size would wrap and later writes would scribble
+      // past the mapping — fail loudly instead
+      munmap(mem, arena_size);
+      unlink(path);
+      delete s;
+      return nullptr;
+    }
     h->heap_off = heap_off;
     h->heap_size = (arena_size - heap_off) & ~(kAlign - 1);
     pthread_mutexattr_t ma;

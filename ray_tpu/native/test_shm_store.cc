@@ -1,0 +1,180 @@
+// Native-level unit tests for the shared-memory store.
+//
+// Reference analogue: the C++ unit tests under
+// src/ray/object_manager/plasma/test/ run via Bazel+gtest with
+// ASan/TSan configs (.bazelrc:114-133). No gtest in this image, so
+// these are assert-based; the pytest wrapper (tests/test_native_store.py)
+// compiles and runs them twice — plain and under
+// -fsanitize=address,undefined — which is what the sanitizer CI configs
+// buy the reference.
+//
+// Build: g++ -std=c++17 -O1 -g [-fsanitize=address,undefined] \
+//            ray_tpu/native/test_shm_store.cc -ldl -pthread -o t && ./t
+#include <dlfcn.h>
+#include <pthread.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+using OpenFn = void* (*)(const char*, uint64_t, int);
+using CloseFn = void (*)(void*);
+using ReconcileFn = int (*)(void*);
+using BaseFn = uint64_t (*)(void*);
+using CreateFn = int (*)(void*, const uint8_t*, uint64_t, uint64_t*);
+using SealFn = int (*)(void*, const uint8_t*);
+using GetFn = int (*)(void*, const uint8_t*, int64_t, uint64_t*, uint64_t*);
+using ReleaseFn = int (*)(void*, const uint8_t*);
+using ContainsFn = int (*)(void*, const uint8_t*);
+using DeleteFn = int (*)(void*, const uint8_t*);
+using EvictFn = uint64_t (*)(void*, uint64_t);
+using StatsFn = void (*)(void*, uint64_t*, uint64_t*, uint64_t*,
+                         uint64_t*);
+
+static OpenFn s_open;
+static CloseFn s_close;
+static ReconcileFn s_reconcile;
+static BaseFn s_base;
+static CreateFn s_create;
+static SealFn s_seal;
+static GetFn s_get;
+static ReleaseFn s_release;
+static ContainsFn s_contains;
+static DeleteFn s_delete;
+static EvictFn s_evict;
+static StatsFn s_stats;
+
+static void make_id(uint8_t* id, int n) {
+  std::memset(id, 0, 20);
+  std::snprintf(reinterpret_cast<char*>(id), 20, "obj-%d", n);
+}
+
+static void put_obj(void* s, int n, const std::string& payload) {
+  uint8_t id[20];
+  make_id(id, n);
+  uint64_t off = 0;
+  assert(s_create(s, id, payload.size(), &off) == 0);
+  std::memcpy(reinterpret_cast<char*>(s_base(s)) + off, payload.data(),
+              payload.size());
+  assert(s_seal(s, id) == 0);
+}
+
+static std::string get_obj(void* s, int n, int64_t timeout_ms = 1000) {
+  uint8_t id[20];
+  make_id(id, n);
+  uint64_t off = 0, size = 0;
+  if (s_get(s, id, timeout_ms, &off, &size) != 0) return "";
+  std::string out(reinterpret_cast<char*>(s_base(s)) + off, size);
+  s_release(s, id);
+  return out;
+}
+
+int main(int argc, char** argv) {
+  assert(argc >= 3 && "usage: test_shm_store <libshmstore.so> <arena>");
+  void* lib = dlopen(argv[1], RTLD_NOW);
+  assert(lib && "dlopen failed");
+  s_open = reinterpret_cast<OpenFn>(dlsym(lib, "shm_store_open"));
+  s_close = reinterpret_cast<CloseFn>(dlsym(lib, "shm_store_close"));
+  s_reconcile =
+      reinterpret_cast<ReconcileFn>(dlsym(lib, "shm_store_reconcile"));
+  s_base = reinterpret_cast<BaseFn>(dlsym(lib, "shm_store_base"));
+  s_create = reinterpret_cast<CreateFn>(dlsym(lib, "shm_store_create"));
+  s_seal = reinterpret_cast<SealFn>(dlsym(lib, "shm_store_seal"));
+  s_get = reinterpret_cast<GetFn>(dlsym(lib, "shm_store_get"));
+  s_release = reinterpret_cast<ReleaseFn>(dlsym(lib, "shm_store_release"));
+  s_contains = reinterpret_cast<ContainsFn>(dlsym(lib, "shm_store_contains"));
+  s_delete = reinterpret_cast<DeleteFn>(dlsym(lib, "shm_store_delete"));
+  s_evict = reinterpret_cast<EvictFn>(dlsym(lib, "shm_store_evict"));
+  s_stats = reinterpret_cast<StatsFn>(dlsym(lib, "shm_store_stats"));
+  assert(s_open && s_create && s_seal && s_get && s_evict);
+
+  const char* arena = argv[2];
+  // metadata (client ref ledgers) needs ~26 MB: a too-small arena must
+  // fail cleanly, not scribble out of bounds (regression: this test
+  // originally segfaulted here)
+  assert(s_open(arena, 8ull << 20, 1) == nullptr);
+  void* s = s_open(arena, 64ull << 20, 1);  // 64 MB
+  assert(s);
+
+  // 1. create/seal/get round trip + contains/delete
+  put_obj(s, 1, "hello-shm");
+  uint8_t id1[20];
+  make_id(id1, 1);
+  assert(s_contains(s, id1) == 1);
+  assert(get_obj(s, 1) == "hello-shm");
+  assert(s_delete(s, 1 ? id1 : id1) == 0);
+  assert(s_contains(s, id1) == 0);
+  std::printf("roundtrip ok\n");
+
+  // 2. blocking get: reader attaches BEFORE the writer puts
+  {
+    std::string got;
+    std::thread reader([&] { got = get_obj(s, 2, 5000); });
+    usleep(50 * 1000);
+    put_obj(s, 2, "late-arrival");
+    reader.join();
+    assert(got == "late-arrival");
+  }
+  std::printf("blocking get ok\n");
+
+  // 3. concurrent writers: 4 threads x 64 objects, then integrity-check
+  {
+    std::vector<std::thread> ws;
+    for (int t = 0; t < 4; ++t) {
+      ws.emplace_back([&, t] {
+        for (int i = 0; i < 64; ++i) {
+          int n = 1000 + t * 64 + i;
+          put_obj(s, n, "payload-" + std::to_string(n));
+        }
+      });
+    }
+    for (auto& w : ws) w.join();
+    for (int t = 0; t < 4; ++t)
+      for (int i = 0; i < 64; ++i) {
+        int n = 1000 + t * 64 + i;
+        assert(get_obj(s, n) == "payload-" + std::to_string(n));
+      }
+  }
+  std::printf("concurrent writers ok\n");
+
+  // 4. eviction under pressure: unpinned objects make room
+  {
+    uint64_t used = 0, cap = 0, nobj = 0, nevict = 0;
+    s_stats(s, &used, &cap, &nobj, &nevict);
+    std::string big(4 * 1024 * 1024, 'x');
+    for (int i = 0; i < 64; ++i) put_obj(s, 2000 + i, big);  // > capacity
+    s_stats(s, &used, &cap, &nobj, &nevict);
+    assert(nevict > 0);
+    assert(used <= cap);
+    // the newest object must still be present
+    assert(get_obj(s, 2063) == big);
+  }
+  std::printf("eviction ok\n");
+
+  // 5. second attached client sees the same objects zero-copy
+  {
+    void* s2 = s_open(arena, 0, 0);
+    assert(s2);
+    put_obj(s, 3, "cross-client");
+    uint8_t id[20];
+    make_id(id, 3);
+    uint64_t off = 0, size = 0;
+    assert(s_get(s2, id, 1000, &off, &size) == 0);
+    assert(std::string(reinterpret_cast<char*>(s_base(s2)) + off, size) ==
+           "cross-client");
+    s_release(s2, id);
+    s_close(s2);
+    // reconcile reclaims the closed client's slot bookkeeping
+    s_reconcile(s);
+  }
+  std::printf("multi-client ok\n");
+
+  s_close(s);
+  std::printf("NATIVE_STORE_TESTS_PASS\n");
+  return 0;
+}

@@ -1,0 +1,53 @@
+"""Native store C-level tests, plain and under sanitizers.
+
+Reference analogue: src/ray/object_manager/plasma/test/*.cc run via
+Bazel with --config=asan / --config=ubsan (.bazelrc:114-133). Here the
+assert-based C++ test binary runs twice: a plain build and an
+AddressSanitizer+UBSan build (the library is recompiled with the
+sanitizer too, so the store's own heap/mutex code is instrumented).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "ray_tpu", "native")
+
+
+def _build_and_run(tmp, sanitize: bool):
+    flags = ["-fsanitize=address,undefined", "-fno-omit-frame-pointer"] \
+        if sanitize else []
+    lib = str(tmp / ("libshmstore_san.so" if sanitize
+                     else "libshmstore_plain.so"))
+    subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-shared", "-fPIC",
+         "-pthread", *flags,
+         os.path.join(NATIVE, "shm_store.cpp"), "-o", lib],
+        check=True, capture_output=True, text=True)
+    binary = str(tmp / ("t_san" if sanitize else "t_plain"))
+    subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", *flags,
+         os.path.join(NATIVE, "test_shm_store.cc"),
+         "-o", binary, "-ldl", "-pthread"],
+        check=True, capture_output=True, text=True)
+    arena = str(tmp / "arena")
+    env = dict(os.environ)
+    if sanitize:
+        # the robust-mutex arena is shared state by design; ASan only
+        # checks this process's accesses
+        env["ASAN_OPTIONS"] = "detect_leaks=0"
+    proc = subprocess.run(
+        [binary, lib, arena], capture_output=True, text=True,
+        timeout=300, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-4000:])
+    assert "NATIVE_STORE_TESTS_PASS" in proc.stdout
+
+
+def test_native_store_plain(tmp_path):
+    _build_and_run(tmp_path, sanitize=False)
+
+
+def test_native_store_asan_ubsan(tmp_path):
+    _build_and_run(tmp_path, sanitize=True)
