@@ -49,7 +49,15 @@ DEAD = "DEAD"
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 persist_path: Optional[str] = None):
+                 persist_path: Optional[str] = None,
+                 session_dir: Optional[str] = None):
+        # structured export events (reference: src/ray/util/event.h +
+        # export_*.proto; the GCS emits control-plane transitions)
+        from ..util.events import EventLogger
+
+        self._events = (
+            EventLogger(session_dir, "gcs") if session_dir else None
+        )
         self._server = RpcServer(host, port)
         self._server.register(self)
         self._pool = ClientPool()
@@ -365,6 +373,10 @@ class GcsServer:
     # ------------------------------------------------------------------
     # nodes + resources + health
     # ------------------------------------------------------------------
+    def _emit(self, event_type: str, entity_id: str = "", **data):
+        if self._events is not None:
+            self._events.emit(event_type, entity_id, data=data)
+
     async def register_node(self, info: dict):
         node_id = info["node_id"]
         self._nodes[node_id] = info
@@ -377,6 +389,9 @@ class GcsServer:
         )
         self._last_heartbeat[node_id] = time.time()
         self._publish("NODE", {"event": "added", "node": info})
+        self._emit("NODE_ADDED", node_id,
+                   address=list(info["address"]),
+                   resources=info.get("resources", {}))
         self._kick_schedulers()
         return True
 
@@ -492,6 +507,7 @@ class GcsServer:
             return
         v.alive = False
         v.available = {}
+        self._emit("NODE_DEAD", node_id, reason=reason)
         # a dead node's last demand report must not drive scale-up forever
         self._node_demand.pop(node_id, None)
         self._node_idle.pop(node_id, None)
@@ -515,6 +531,9 @@ class GcsServer:
     # jobs
     # ------------------------------------------------------------------
     async def add_job(self, job_info: dict):
+        self._emit("JOB_STARTED", job_info.get("job_id", ""),
+                   **{k: v for k, v in job_info.items()
+                      if isinstance(v, (str, int, float))})
         self._jobs[job_info["job_id"]] = {**job_info, "state": "RUNNING",
                                           "start_time": time.time()}
         self._mark_dirty()
@@ -527,6 +546,7 @@ class GcsServer:
             job["state"] = "FINISHED"
             self._mark_dirty()
             job["end_time"] = time.time()
+            self._emit("JOB_FINISHED", job_id)
         # Kill non-detached actors belonging to the job.
         for aid, rec in list(self._actors.items()):
             if rec["job_id"] == job_id and not rec.get("detached"):
@@ -566,6 +586,8 @@ class GcsServer:
         self._actors[aid] = rec
         self._pending_actors.append(aid)
         self._mark_dirty()
+        self._emit("ACTOR_REGISTERED", aid, name=name or "",
+                   job_id=spec.get("job_id"))
         self._kick_schedulers()
         return {"ok": True}
 
@@ -695,6 +717,7 @@ class GcsServer:
         rec["state"] = DEAD
         self._mark_dirty()
         rec["death_cause"] = reason
+        self._emit("ACTOR_DEAD", aid, reason=reason)
         self._publish("ACTOR", {"event": "dead", "actor_id": aid,
                                 "reason": reason})
 
@@ -726,6 +749,7 @@ class GcsServer:
             return  # killed while constructing
         rec["state"] = ALIVE
         self._mark_dirty()
+        self._emit("ACTOR_ALIVE", aid, node_id=node_id)
         self._publish("ACTOR", {"event": "alive", "actor_id": aid,
                                 "address": worker_addr,
                                 "node_id": node_id})
@@ -993,13 +1017,15 @@ def main():
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--config", default=None)
     parser.add_argument("--persist-path", default=None)
+    parser.add_argument("--session-dir", default=None)
     args = parser.parse_args()
     if args.config:
         set_config(Config.from_json(args.config))
 
     async def run():
         server = GcsServer(args.host, args.port,
-                           persist_path=args.persist_path)
+                           persist_path=args.persist_path,
+                           session_dir=args.session_dir)
         await server.start()
         print(f"GCS listening on {server.address}", flush=True)
         await asyncio.Event().wait()

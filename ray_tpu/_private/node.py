@@ -62,6 +62,7 @@ def start_gcs_server(session_dir: str, port: int = 0) -> Tuple[subprocess.Popen,
             # durable actor/PG/job/KV tables: a restarted GCS (same
             # session) restores them (reference: redis_store_client.cc)
             "--persist-path", os.path.join(session_dir, "gcs_state.pkl"),
+            "--session-dir", session_dir,
         ],
         stdout=subprocess.PIPE,
         stderr=log,

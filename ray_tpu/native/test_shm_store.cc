@@ -107,7 +107,7 @@ int main(int argc, char** argv) {
   make_id(id1, 1);
   assert(s_contains(s, id1) == 1);
   assert(get_obj(s, 1) == "hello-shm");
-  assert(s_delete(s, 1 ? id1 : id1) == 0);
+  assert(s_delete(s, id1) == 0);
   assert(s_contains(s, id1) == 0);
   std::printf("roundtrip ok\n");
 

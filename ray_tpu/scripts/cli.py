@@ -258,6 +258,44 @@ def cmd_jobs(args):
         print("stopped" if ok else "not running")
 
 
+def cmd_memory(args):
+    """Object-store usage per node (reference: `ray memory`,
+    scripts.py:2084)."""
+    import ray_tpu as ray
+    from ray_tpu.util import state
+
+    host, port = _resolve_address(args)
+    ray.init(address=f"{host}:{port}")
+    objs = state.list_objects(limit=args.limit)
+    by_node: dict = {}
+    for o in objs:
+        by_node.setdefault(o["node_id"], []).append(o)
+    for nid, items in by_node.items():
+        print(f"node {nid[:12]}: {len(items)} objects")
+        for o in items:
+            print(f"  {o['object_id']}")
+    if not objs:
+        print("no shm objects")
+
+
+def cmd_events(args):
+    """Structured export events for the session (reference:
+    export_event_logger.py output)."""
+    import json as _json
+
+    from ray_tpu.util.events import read_events
+
+    session_dir = args.session_dir
+    if session_dir is None:
+        cluster = _load_cluster()
+        if cluster is None:
+            print("no recorded cluster; pass --session-dir")
+            return
+        session_dir = cluster["session_dir"]
+    for e in read_events(session_dir):
+        print(_json.dumps(e))
+
+
 def cmd_timeline(args):
     import ray_tpu as ray
 
@@ -323,6 +361,15 @@ def build_parser() -> argparse.ArgumentParser:
     js = jsub.add_parser("stop")
     js.add_argument("job_id")
     s.set_defaults(fn=cmd_jobs)
+
+    s = sub.add_parser("memory", help="object store contents per node")
+    s.add_argument("--address")
+    s.add_argument("--limit", type=int, default=100)
+    s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser("events", help="dump structured export events")
+    s.add_argument("--session-dir", default=None)
+    s.set_defaults(fn=cmd_events)
 
     s = sub.add_parser("timeline", help="export chrome-trace task events")
     s.add_argument("--address")

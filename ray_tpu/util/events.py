@@ -1,0 +1,71 @@
+"""Structured export events: an append-only JSONL audit stream.
+
+Reference: src/ray/util/event.h + export_*.proto — every process can
+emit typed events (task/actor/node/job state transitions) that an
+aggregator ships for external consumption
+(_private/event/export_event_logger.py). Here events append to
+``<session_dir>/events/events_<source>.jsonl`` — one line per event,
+schema {timestamp, source, event_type, severity, entity_id, data} —
+and the GCS emits the control-plane transitions itself.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class EventLogger:
+    def __init__(self, session_dir: str, source: str):
+        self._dir = os.path.join(session_dir, "events")
+        os.makedirs(self._dir, exist_ok=True)
+        self._path = os.path.join(self._dir,
+                                  f"events_{source}.jsonl")
+        self._source = source
+        self._lock = threading.Lock()
+        self._fh = open(self._path, "a", buffering=1)
+
+    def emit(self, event_type: str, entity_id: str = "",
+             severity: str = "INFO",
+             data: Optional[Dict[str, Any]] = None) -> None:
+        rec = {
+            "timestamp": time.time(),
+            "source": self._source,
+            "event_type": event_type,
+            "severity": severity,
+            "entity_id": entity_id,
+            "data": data or {},
+        }
+        try:
+            with self._lock:
+                self._fh.write(json.dumps(rec, default=str) + "\n")
+        except Exception:
+            pass  # events must never take the emitter down
+
+    def close(self):
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+
+
+def read_events(session_dir: str, source: Optional[str] = None) -> list:
+    out = []
+    d = os.path.join(session_dir, "events")
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if source and name != f"events_{source}.jsonl":
+            continue
+        with open(os.path.join(d, name)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass  # torn tail write
+    out.sort(key=lambda e: e.get("timestamp", 0))
+    return out

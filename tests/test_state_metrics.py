@@ -160,3 +160,33 @@ def test_counter_gauge_labels():
     assert 'reqs_total{node="n1",route="/a"} 3.0' in text
     assert 'reqs_total{node="n1",route="/b"} 5.0' in text
     assert 'temp{node="n1"} 3.5' in text
+
+
+# ---------------------------------------------------------------------------
+# structured export events (reference: util/event.h + export_*.proto)
+# ---------------------------------------------------------------------------
+def test_export_events_written(ray_start):
+    import time as _t
+
+    import ray_tpu.api as api
+    from ray_tpu.util.events import read_events
+
+    @ray.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    a = Marker.remote()
+    ray.get(a.ping.remote(), timeout=60)
+    session_dir = api.global_worker().session_dir
+    deadline = _t.time() + 30
+    types = set()
+    while _t.time() < deadline:
+        types = {e["event_type"]
+                 for e in read_events(session_dir, source="gcs")}
+        if {"NODE_ADDED", "ACTOR_REGISTERED", "ACTOR_ALIVE"} <= types:
+            break
+        _t.sleep(0.5)
+    assert "NODE_ADDED" in types
+    assert "ACTOR_REGISTERED" in types
+    assert "ACTOR_ALIVE" in types
