@@ -108,9 +108,33 @@ def collecting_refs(out: list):
         _arg_ref_collector.refs = prev
 
 
+_deser_borrow_batch = threading.local()
+
+
+@contextlib.contextmanager
+def batching_borrows():
+    """Deserialization-scope borrow batching: refs rehydrated inside
+    register in ONE pass (one lock acquisition + one notify queue hit
+    per owner) instead of per ref — an object holding 10k refs pays
+    ~10k fewer lock round-trips per load."""
+    prev = getattr(_deser_borrow_batch, "refs", None)
+    _deser_borrow_batch.refs = batch = []
+    try:
+        yield
+    finally:
+        _deser_borrow_batch.refs = prev
+        w = _global_worker
+        if w is not None and batch:
+            w.register_borrowed_refs_bulk(batch)
+
+
 def _rehydrate_ref(oid_bytes: bytes, owner_addr):
     ref = ObjectRef(ObjectID(oid_bytes), tuple(owner_addr) if owner_addr else None,
                     _register=False)
+    batch = getattr(_deser_borrow_batch, "refs", None)
+    if batch is not None:
+        batch.append(ref)
+        return ref
     w = _global_worker
     if w is not None:
         w.register_borrowed_ref(ref)
@@ -158,10 +182,15 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __del__(self):
+        # lock-free: GC of a big ref container (10k+ refs) must not pay
+        # a lock round-trip per ref — appends batch and drain under ONE
+        # lock (list.append is GIL-atomic)
         w = _global_worker
         if w is not None:
             try:
-                w.remove_local_ref(self.id)
+                w._pending_unrefs.append(self.id)
+                if len(w._pending_unrefs) >= 256:
+                    w._drain_unrefs()
             except Exception:
                 pass
 
@@ -352,6 +381,10 @@ class CoreWorker:
         # batched borrower (de)registration: deserializing a container of
         # N refs costs O(1) flush RPCs per owner instead of N
         self._borrow_notify_lock = threading.Lock()
+        # GC'd refs awaiting batched unref (ObjectRef.__del__); the
+        # swap in _drain_unrefs must be atomic vs concurrent drains
+        self._pending_unrefs: List[ObjectID] = []
+        self._unref_swap_lock = threading.Lock()
         self._borrow_add_batch: Dict[tuple, set] = {}
         self._borrow_remove_batch: Dict[tuple, set] = {}
         self._borrow_flush_scheduled = False
@@ -402,6 +435,7 @@ class CoreWorker:
         loop.spawn(self._flush_task_events_loop())
         loop.spawn(self._actor_event_loop())
         loop.spawn(self._metrics_flush_loop())
+        loop.spawn(self._unref_sweep_loop())
         if self.mode == "driver" and self._cfg.log_to_driver:
             loop.spawn(self._log_stream_loop())
         if self.mode == "worker" and self._cfg.log_to_driver:
@@ -427,6 +461,10 @@ class CoreWorker:
                 )
             except Exception:
                 pass
+        try:
+            self._drain_unrefs()
+        except Exception:
+            pass
         self._flush_pending_frees()
         try:
             EventLoopThread.get().run(self._server.stop(), 5.0)
@@ -509,6 +547,17 @@ class CoreWorker:
 
         get_registry().counter(name, desc).inc(n)
 
+    async def _unref_sweep_loop(self):
+        """Drain sub-threshold GC'd refs so small batches still release
+        promptly (the 256-threshold inline drain covers bulk churn)."""
+        while not self._exit.is_set():
+            await asyncio.sleep(0.1)
+            try:
+                if self._pending_unrefs:
+                    self._drain_unrefs()
+            except Exception:
+                pass
+
     async def _metrics_flush_loop(self):
         from .metrics import get_registry
 
@@ -538,6 +587,11 @@ class CoreWorker:
 
     def put_object(self, value: Any, _owner_inline_hint: bool = True) -> ObjectRef:
         self._count("ray_tpu_objects_put_total", "ray.put calls")
+        if self._pending_unrefs:
+            # release GC'd refs BEFORE allocating: a dropped large
+            # object must make room for this put instead of waiting
+            # for the sweep and forcing eviction churn
+            self._drain_unrefs()
         oid = self._next_put_id()
         meta, buffers = serialization.serialize(value)
         size = serialization.serialized_size(meta, buffers)
@@ -835,26 +889,45 @@ class CoreWorker:
                 rec.local_refs += 1
 
     def remove_local_ref(self, oid: ObjectID):
+        # single implementation: one-element immediate drain (the GC
+        # path batches via _pending_unrefs instead)
+        self._pending_unrefs.append(oid)
+        self._drain_unrefs()
+
+    def _drain_unrefs(self):
+        """Batched remove_local_ref for GC'd refs (see ObjectRef.__del__):
+        the whole batch processes under one records-lock acquisition."""
+        with self._unref_swap_lock:
+            batch, self._pending_unrefs = self._pending_unrefs, []
+        if not batch:
+            return
+        mem_deletes: List[ObjectID] = []
+        notify: Dict[tuple, List[bytes]] = {}
         with self._records_lock:
-            rec = self._records.get(oid.binary())
-            if rec is not None:
-                rec.local_refs -= 1
-                if (
-                    rec.local_refs <= 0
-                    and rec.borrowers <= 0
-                    and not rec.pending
-                ):
-                    self._free_object(oid, rec)
-                return
-            ent = self._borrowed.get(oid.binary())
-            if ent is not None:
-                ent[0] -= 1
-                if ent[0] <= 0:
-                    self._borrowed.pop(oid.binary(), None)
-                    self.memory_store.delete(oid)
-                    self._queue_borrow_notify(
-                        tuple(ent[1]), oid.binary(), add=False
-                    )
+            for oid in batch:
+                key = oid.binary()
+                rec = self._records.get(key)
+                if rec is not None:
+                    rec.local_refs -= 1
+                    if (
+                        rec.local_refs <= 0
+                        and rec.borrowers <= 0
+                        and not rec.pending
+                    ):
+                        self._free_object(oid, rec)
+                    continue
+                ent = self._borrowed.get(key)
+                if ent is not None:
+                    ent[0] -= 1
+                    if ent[0] <= 0:
+                        self._borrowed.pop(key, None)
+                        mem_deletes.append(oid)
+                        notify.setdefault(
+                            tuple(ent[1]), []).append(key)
+        for oid in mem_deletes:
+            self.memory_store.delete(oid)
+        for addr, keys in notify.items():
+            self._queue_borrow_notify_many(addr, keys, add=False)
 
     def _retain_ref(self, oid: ObjectID, owner_address):
         """Pin an object while it's an in-flight task argument (the
@@ -878,21 +951,35 @@ class CoreWorker:
     def _release_ref(self, oid: ObjectID):
         self.remove_local_ref(oid)
 
-    def register_borrowed_ref(self, ref: ObjectRef):
-        # Best-effort async notification to the owner (the reference tracks
-        # borrowers precisely via the borrowing protocol; we approximate).
-        if ref.owner_address is None or ref.owner_address == self.address:
-            self.add_local_ref(ref.id)
-            return
+    def register_borrowed_refs_bulk(self, refs: List["ObjectRef"]):
+        """One-pass registration for refs rehydrated by one load (see
+        batching_borrows): a single records-lock acquisition and one
+        notify-queue insertion per distinct owner."""
+        notify: Dict[tuple, List[bytes]] = {}
         with self._records_lock:
-            ent = self._borrowed.get(ref.id.binary())
-            if ent is not None:
-                ent[0] += 1
-                return
-            self._borrowed[ref.id.binary()] = [1, tuple(ref.owner_address)]
-        self._queue_borrow_notify(
-            tuple(ref.owner_address), ref.id.binary(), add=True
-        )
+            for ref in refs:
+                if ref.owner_address is None \
+                        or ref.owner_address == self.address:
+                    rec = self._records.get(ref.id.binary())
+                    if rec is not None:
+                        rec.local_refs += 1
+                    continue
+                key = ref.id.binary()
+                ent = self._borrowed.get(key)
+                if ent is not None:
+                    ent[0] += 1
+                    continue
+                addr = tuple(ref.owner_address)
+                self._borrowed[key] = [1, addr]
+                notify.setdefault(addr, []).append(key)
+        for addr, oids in notify.items():
+            self._queue_borrow_notify_many(addr, oids, add=True)
+
+    def register_borrowed_ref(self, ref: ObjectRef):
+        # Best-effort async notification to the owner (the reference
+        # tracks borrowers precisely via the borrowing protocol; we
+        # approximate). Single implementation: one-element bulk.
+        self.register_borrowed_refs_bulk([ref])
 
     async def _rpc_add_borrower(self, object_id: bytes):
         return await self._rpc_add_borrowers([object_id])
@@ -924,13 +1011,17 @@ class CoreWorker:
 
     def _queue_borrow_notify(self, addr: tuple, oid_bytes: bytes,
                              add: bool):
+        self._queue_borrow_notify_many(addr, (oid_bytes,), add)
+
+    def _queue_borrow_notify_many(self, addr: tuple, oid_list,
+                                  add: bool):
         """Coalesce borrower notifications per owner; flushed in-order a
         few ms later (one RPC per owner per flush)."""
         with self._borrow_notify_lock:
             batch = (
                 self._borrow_add_batch if add else self._borrow_remove_batch
             )
-            batch.setdefault(addr, set()).add(oid_bytes)
+            batch.setdefault(addr, set()).update(oid_list)
             if self._borrow_flush_scheduled:
                 return
             self._borrow_flush_scheduled = True

@@ -102,7 +102,10 @@ def loads_from(view: memoryview) -> Any:
         off += _HEADER.size + pad
         buffers.append(view[off : off + nbytes].toreadonly())
         off += nbytes
-    return pickle.loads(meta, buffers=buffers)
+    from .core_worker import batching_borrows
+
+    with batching_borrows():
+        return pickle.loads(meta, buffers=buffers)
 
 
 def loads(data: bytes) -> Any:

@@ -258,8 +258,11 @@ def test_llm_engine_recovers_after_decode_fault():
     from ray_tpu.models import LlamaConfig
 
     cfg = LlamaConfig.tiny(max_seq_len=64)
+    # decode_chunk=1: the fault is injected into the single-step decode
+    # fn, which must be the active path for the injection to fire
     eng = LLMEngine(cfg, engine_config=EngineConfig(
         max_batch_size=2, max_seq_len=64, prefill_buckets=(16, 32),
+        decode_chunk=1,
     ))
     try:
         good = eng.generate([1, 2, 3], SamplingParams(max_tokens=4),
@@ -286,5 +289,36 @@ def test_llm_engine_recovers_after_decode_fault():
                              timeout=120)
         assert again.finish_reason in ("length", "stop")
         assert again.token_ids == good.token_ids  # cache was rebuilt clean
+    finally:
+        eng.shutdown()
+
+
+def test_llm_engine_recovers_after_multistep_decode_fault():
+    """Same recovery contract for the multi-step (chunked) decode path."""
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+    from ray_tpu.models import LlamaConfig
+
+    cfg = LlamaConfig.tiny(max_seq_len=64)
+    eng = LLMEngine(cfg, engine_config=EngineConfig(
+        max_batch_size=2, max_seq_len=64, prefill_buckets=(16, 32),
+        decode_chunk=4,
+    ))
+    try:
+        good = eng.generate([1, 2, 3], SamplingParams(max_tokens=4),
+                            timeout=120)
+        real = eng._decode_multi
+
+        def faulty(params, cache, *a, **kw):
+            del cache  # emulate post-donation fault
+            raise RuntimeError("injected multi-step fault")
+
+        eng._decode_multi = faulty
+        bad = eng.generate([4, 5, 6], SamplingParams(max_tokens=8),
+                           timeout=120)
+        assert bad.finish_reason.startswith("error")
+        eng._decode_multi = real
+        again = eng.generate([1, 2, 3], SamplingParams(max_tokens=4),
+                             timeout=120)
+        assert again.token_ids == good.token_ids
     finally:
         eng.shutdown()
