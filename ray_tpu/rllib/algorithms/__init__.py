@@ -1,4 +1,5 @@
 from .ppo import PPO, PPOConfig
 from .dqn import DQN, DQNConfig
+from .sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig"]

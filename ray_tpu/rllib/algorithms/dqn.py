@@ -44,13 +44,17 @@ class DQNConfig(AlgorithmConfig):
 
 class ReplayBuffer:
     """Uniform ring buffer (reference:
-    rllib/utils/replay_buffers/replay_buffer.py)."""
+    rllib/utils/replay_buffers/replay_buffer.py). Discrete actions by
+    default; pass act_dim for continuous-control consumers (SAC)."""
 
-    def __init__(self, capacity: int, obs_dim: int):
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int = 0):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros((capacity,), np.int32)
+        self.actions = (
+            np.zeros((capacity, act_dim), np.float32)
+            if act_dim else np.zeros((capacity,), np.int32)
+        )
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
         self.pos = 0
@@ -69,6 +73,18 @@ class ReplayBuffer:
 
     def sample(self, rng: np.random.Generator, n: int) -> dict:
         idx = rng.integers(0, self.size, n)
+        return self._gather(idx)
+
+    def sample_many(self, rng: np.random.Generator, n: int,
+                    batch: int) -> dict:
+        """n stacked minibatches [n, batch, ...] with ONE gather per
+        column (feeds scanned multi-update steps)."""
+        idx = rng.integers(0, self.size, n * batch)
+        flat = self._gather(idx)
+        return {k: v.reshape((n, batch) + v.shape[1:])
+                for k, v in flat.items()}
+
+    def _gather(self, idx) -> dict:
         return {
             OBS: self.obs[idx],
             NEXT_OBS: self.next_obs[idx],

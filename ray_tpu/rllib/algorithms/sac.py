@@ -1,0 +1,260 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference: rllib/algorithms/sac/sac.py (SACConfig/SAC) +
+sac/torch/sac_torch_learner.py (twin-Q + entropy-regularized actor +
+auto-tuned temperature). TPU-first shape: the whole update (twin-Q TD
+step, reparameterized actor step, alpha step, polyak target update) is
+one jitted program; the replay ring stays host-side like DQN's.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..algorithm import Algorithm
+from ..config import AlgorithmConfig
+from ..env import make_env
+from ..learner import Learner
+from ..rl_module import _mlp_apply, _mlp_init
+from ..sample_batch import (
+    ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch,
+)
+from .dqn import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.buffer_size = 100_000
+        self.learning_starts = 1_000
+        self.batch_size = 256
+        self.num_updates_per_iter = 32
+        self.tau = 0.005              # polyak target coefficient
+        self.initial_alpha = 0.2
+        self.autotune_alpha = True
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class SACModule:
+    """Squashed-gaussian policy + twin Q networks over flat obs."""
+
+    def __init__(self, obs_space, action_space, hiddens=(256, 256)):
+        self.obs_dim = int(np.prod(obs_space.shape))
+        self.act_dim = int(np.prod(action_space.shape))
+        self.act_scale = float(action_space.high)
+        self.hiddens = tuple(hiddens)
+        self.discrete = False
+
+    def init(self, key) -> dict:
+        kp, k1, k2 = jax.random.split(key, 3)
+        qin = self.obs_dim + self.act_dim
+        return {
+            "pi": _mlp_init(kp, (self.obs_dim, *self.hiddens,
+                                 2 * self.act_dim)),
+            "q1": _mlp_init(k1, (qin, *self.hiddens, 1), out_scale=1.0),
+            "q2": _mlp_init(k2, (qin, *self.hiddens, 1), out_scale=1.0),
+        }
+
+    def pi(self, params, obs, key):
+        """Reparameterized squashed-gaussian sample -> (action, logp)."""
+        out = _mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, -10.0, 2.0)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        # tanh-squash correction
+        # tanh-squash + scale Jacobian: density of the EMITTED action
+        # (act * act_scale), not the unit-range one
+        logp = jnp.sum(
+            -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - jnp.log(1.0 - act ** 2 + 1e-6)
+            - jnp.log(self.act_scale),
+            axis=-1,
+        )
+        return act * self.act_scale, logp
+
+    def q(self, params, which: str, obs, act) -> jax.Array:
+        x = jnp.concatenate([obs, act / self.act_scale], axis=-1)
+        return _mlp_apply(params[which], x)[..., 0]
+
+    # EnvRunner protocol (actor-critic style sampling)
+    def sample_action(self, params, obs, key):
+        act, logp = self.pi(params, obs, key)
+        q = self.q(params, "q1", obs, act)
+        return act, logp, q
+
+    def logp(self, params, obs, actions):  # for API symmetry
+        raise NotImplementedError("SAC is off-policy; logp unused")
+
+    def best_action(self, params, obs):
+        out = _mlp_apply(params["pi"], obs)
+        mean, _ = jnp.split(out, 2, axis=-1)
+        return jnp.tanh(mean) * self.act_scale
+
+
+class SACLearner(Learner):
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, {"q1": self.params["q1"],
+                       "q2": self.params["q2"]})
+        self.log_alpha = jnp.asarray(
+            np.log(config.get("initial_alpha", 0.2)), jnp.float32)
+        self.alpha_opt = optax.adam(config.get("lr", 3e-4))
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self.buffer = ReplayBuffer(
+            config.get("buffer_size", 100_000), module.obs_dim,
+            act_dim=module.act_dim)
+        self._rng = np.random.default_rng(seed)
+        gamma = config.get("gamma", 0.99)
+        tau = config.get("tau", 0.005)
+        autotune = config.get("autotune_alpha", True)
+        target_entropy = -float(module.act_dim)
+        mod = module
+
+        def update_step(params, opt_state, target, log_alpha,
+                        alpha_opt_state, mb, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # --- critics: TD target with entropy bonus
+            next_a, next_logp = mod.pi(params, mb[NEXT_OBS], k1)
+            tq = jnp.minimum(
+                mod.q({"q1": target["q1"]}, "q1", mb[NEXT_OBS], next_a),
+                mod.q({"q2": target["q2"]}, "q2", mb[NEXT_OBS], next_a),
+            )
+            backup = mb[REWARDS] + gamma * (1.0 - mb[DONES]) * (
+                tq - alpha * next_logp)
+            backup = jax.lax.stop_gradient(backup)
+
+            def critic_loss(p):
+                q1 = mod.q(p, "q1", mb[OBS], mb[ACTIONS])
+                q2 = mod.q(p, "q2", mb[OBS], mb[ACTIONS])
+                return (jnp.mean((q1 - backup) ** 2)
+                        + jnp.mean((q2 - backup) ** 2))
+
+            def actor_loss(p):
+                a, logp = mod.pi(p, mb[OBS], k2)
+                q = jnp.minimum(mod.q(params, "q1", mb[OBS], a),
+                                mod.q(params, "q2", mb[OBS], a))
+                return jnp.mean(alpha * logp - q), logp
+
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(params)
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(params)
+            # actor grads only touch pi; critic grads only q1/q2
+            grads = {"pi": a_grads["pi"], "q1": c_grads["q1"],
+                     "q2": c_grads["q2"]}
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            if autotune:
+                def alpha_loss(la):
+                    return -jnp.mean(
+                        jnp.exp(la)
+                        * jax.lax.stop_gradient(logp + target_entropy))
+
+                al, ag = jax.value_and_grad(alpha_loss)(log_alpha)
+                aupd, alpha_opt_state = self.alpha_opt.update(
+                    ag, alpha_opt_state)
+                log_alpha = optax.apply_updates(log_alpha, aupd)
+
+            target = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "alpha": jnp.exp(log_alpha),
+                       "entropy": -jnp.mean(logp)}
+            return (params, opt_state, target, log_alpha,
+                    alpha_opt_state, metrics)
+
+        # ALL updates of one iteration run as one lax.scan dispatch —
+        # minibatches are sampled host-side and stacked [N, B, ...];
+        # per-update host round-trips would dominate otherwise
+        def update_scan(params, opt_state, target, log_alpha,
+                        alpha_opt_state, mbs, key):
+            def step(carry, xs):
+                p, o, t, la, ao = carry
+                mb, k = xs
+                p, o, t, la, ao, m = update_step(p, o, t, la, ao, mb, k)
+                return (p, o, t, la, ao), m
+
+            n = mbs[OBS].shape[0]
+            keys = jax.random.split(key, n)
+            (params, opt_state, target, log_alpha, alpha_opt_state), ms = \
+                jax.lax.scan(
+                    step,
+                    (params, opt_state, target, log_alpha,
+                     alpha_opt_state),
+                    (mbs, keys),
+                )
+            metrics = {k: v[-1] for k, v in ms.items()}
+            return (params, opt_state, target, log_alpha,
+                    alpha_opt_state, metrics)
+
+        self._update_jit = jax.jit(update_scan)
+
+    def compute_grads(self, batch):
+        raise NotImplementedError(
+            "SAC does not support multi-learner DDP (the update couples "
+            "critic/actor/alpha/target steps); use num_learners=0")
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        self.buffer.add_batch(batch)
+        if self.buffer.size < self.config.get("learning_starts", 1000):
+            return {"critic_loss": float("nan"),
+                    "buffer_size": float(self.buffer.size)}
+        n = self.config.get("num_updates_per_iter", 32)
+        bs = self.config.get("batch_size", 256)
+        mbs = {k: jnp.asarray(v)
+               for k, v in self.buffer.sample_many(
+                   self._rng, n, bs).items()}
+        self.key, sub = jax.random.split(self.key)
+        (self.params, self.opt_state, self.target_params,
+         self.log_alpha, self.alpha_opt_state, metrics) = \
+            self._update_jit(
+                self.params, self.opt_state, self.target_params,
+                self.log_alpha, self.alpha_opt_state, mbs, sub)
+        out = {k: float(v) for k, v in metrics.items()}
+        out["buffer_size"] = float(self.buffer.size)
+        self._metrics = out
+        return out
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["log_alpha"] = float(self.log_alpha)
+        state["alpha_opt_state"] = jax.device_get(self.alpha_opt_state)
+        return state
+
+    def set_state(self, state: dict) -> bool:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.device_put(state["target_params"])
+        if "log_alpha" in state:
+            self.log_alpha = jnp.asarray(state["log_alpha"],
+                                         jnp.float32)
+        if "alpha_opt_state" in state:
+            self.alpha_opt_state = jax.device_put(
+                state["alpha_opt_state"])
+        return True
+
+
+class SAC(Algorithm):
+    learner_cls = SACLearner
+
+    def _build_module(self):
+        probe = make_env(self.config.env, **self.config.env_config)
+        return SACModule(probe.observation_space, probe.action_space,
+                         hiddens=self.config.hiddens)

@@ -174,3 +174,60 @@ def test_checkpoint_round_trip(tmp_path):
         jax.tree_util.tree_leaves(algo2.learner_group.get_weights()),
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sac_improves_pendulum():
+    from ray_tpu.rllib import SACConfig
+
+    cfg = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        # update-to-data ratio 1: all 256 updates run as ONE scanned
+        # dispatch per iteration
+        .training(learning_starts=512, batch_size=128,
+                  num_updates_per_iter=256)
+        .debugging(seed=0)
+    )
+    algo = cfg.build_algo()
+    first = last = None
+    for _ in range(20):
+        res = algo.train()
+        r = res["episode_return_mean"]
+        if not np.isnan(r):
+            if first is None:
+                first = r
+            last = r
+    # Pendulum returns are negative costs; from ~-1450 random, SAC
+    # reaches ~-600 or better by ~5k steps at UTD 1
+    assert first is not None and last is not None
+    assert last > first + 300, (first, last)
+    assert np.isfinite(res["learner/critic_loss"])
+    assert res["learner/alpha"] > 0
+
+
+def test_sac_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rllib import SACConfig
+
+    cfg = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_envs_per_env_runner=4,
+                     rollout_fragment_length=8)
+        .training(learning_starts=64, num_updates_per_iter=2,
+                  batch_size=32)
+    )
+    algo = cfg.build_algo()
+    for _ in range(4):
+        algo.train()
+    ckpt = algo.save(str(tmp_path / "sac"))
+    algo2 = cfg.build_algo()
+    algo2.restore(ckpt)
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(algo.learner_group.get_weights()),
+        jax.tree_util.tree_leaves(algo2.learner_group.get_weights()),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
