@@ -37,6 +37,7 @@ class SACConfig(AlgorithmConfig):
         self.tau = 0.005              # polyak target coefficient
         self.initial_alpha = 0.2
         self.autotune_alpha = True
+        self.hiddens = (256, 256)     # SAC-standard network width
 
     @property
     def algo_class(self):
@@ -87,11 +88,12 @@ class SACModule:
         x = jnp.concatenate([obs, act / self.act_scale], axis=-1)
         return _mlp_apply(params[which], x)[..., 0]
 
-    # EnvRunner protocol (actor-critic style sampling)
+    # EnvRunner protocol (actor-critic style sampling). SAC never
+    # consumes the logp/values columns (off-policy replay keeps only
+    # transitions), so no Q forward on the sampling hot path.
     def sample_action(self, params, obs, key):
         act, logp = self.pi(params, obs, key)
-        q = self.q(params, "q1", obs, act)
-        return act, logp, q
+        return act, logp, jnp.zeros_like(logp)
 
     def logp(self, params, obs, actions):  # for API symmetry
         raise NotImplementedError("SAC is off-policy; logp unused")

@@ -17,6 +17,11 @@ TPU-native differences (SURVEY §7):
   - failures restart the whole gang from the last checkpoint (a pjit
     program needs every host of the slice; no per-worker elasticity).
 """
+from .torch_trainer import (  # noqa: F401
+    TorchTrainer,
+    prepare_data_loader,
+    prepare_model,
+)
 from .api import (  # noqa: F401
     FailureConfig,
     JaxTrainer,

@@ -107,6 +107,23 @@ class TrainContext:
             process_id=self.rank,
         )
 
+    def setup_torch_distributed(self, backend: str = "gloo"):
+        """torch.distributed.init_process_group over the same group
+        rendezvous (reference: _TorchBackend.on_start,
+        train/torch/config.py:115,153 — TCP store on rank 0)."""
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            return
+        addr = self.rendezvous.get(
+            "torch_coordinator", self.rendezvous["coordinator"])
+        dist.init_process_group(
+            backend,
+            init_method=f"tcp://{addr}",
+            rank=self.rank,
+            world_size=self.world_size,
+        )
+
 
 _context: Optional[TrainContext] = None
 
@@ -303,7 +320,15 @@ class JaxTrainer:
             # rendezvous: rank0's host + a free port for jax.distributed
             host = ray.get(workers[0].hostname.remote(), timeout=120)
             port = ray.get(workers[0].free_port.remote(), timeout=60)
-            rendezvous = {"coordinator": f"{host}:{port}"}
+            torch_port = ray.get(
+                workers[0].free_port.remote(), timeout=60)
+            rendezvous = {
+                "coordinator": f"{host}:{port}",
+                # separate port: a train_func may use BOTH backends
+                # (jax TPU compute + torch data loading); the two
+                # rank-0 stores must not collide
+                "torch_coordinator": f"{host}:{torch_port}",
+            }
 
             latest = storage.latest_checkpoint()
             ray.get(

@@ -1,0 +1,63 @@
+"""TorchTrainer: the reference's flagship Train API, on this runtime.
+
+Reference: train/v2/torch/torch_trainer.py:17 (TorchTrainer over
+DataParallelTrainer) + train/torch/train_loop_utils.py (prepare_model /
+prepare_data_loader wrapping DDP). The gang machinery (placement group,
+rendezvous, report/checkpoint, whole-group restart from latest
+checkpoint) is shared with JaxTrainer; the backend hook is
+torch.distributed over gloo (CPU; the TPU compute path in this
+framework is jax — torch interop exists for the reference's
+data-loading and CPU-model ecosystems).
+"""
+from __future__ import annotations
+
+from .api import JaxTrainer, get_context
+
+
+class TorchTrainer(JaxTrainer):
+    """train_func runs per rank; call
+    ``ray_tpu.train.get_context().setup_torch_distributed()`` (or use
+    prepare_model, which does it for you) before collective work."""
+
+
+def prepare_model(model):
+    """Wrap a torch model for data-parallel training (reference:
+    train/torch/train_loop_utils.py prepare_model — DDP when
+    world_size > 1; single-worker runs stay group-free, mirroring
+    setup_jax_distributed's guard)."""
+    ctx = get_context()
+    if ctx.get_world_size() <= 1:
+        return model
+    ctx.setup_torch_distributed()
+    from torch.nn.parallel import DistributedDataParallel
+
+    return DistributedDataParallel(model)
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across ranks (reference: prepare_data_loader
+    attaches a DistributedSampler)."""
+    ctx = get_context()
+    if ctx.get_world_size() <= 1:
+        return loader
+    import torch.utils.data as tud
+
+    # preserve the caller's ordering intent: only shuffle if the
+    # original loader shuffled (RandomSampler)
+    shuffled = isinstance(
+        getattr(loader, "sampler", None), tud.RandomSampler)
+    sampler = tud.distributed.DistributedSampler(
+        loader.dataset,
+        num_replicas=ctx.get_world_size(),
+        rank=ctx.get_world_rank(),
+        shuffle=shuffled,
+    )
+    return tud.DataLoader(
+        loader.dataset,
+        batch_size=loader.batch_size,
+        sampler=sampler,
+        num_workers=loader.num_workers,
+        collate_fn=loader.collate_fn,
+        drop_last=loader.drop_last,
+        pin_memory=loader.pin_memory,
+    )

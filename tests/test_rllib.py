@@ -185,9 +185,10 @@ def test_sac_improves_pendulum():
         .env_runners(num_envs_per_env_runner=8,
                      rollout_fragment_length=32)
         # update-to-data ratio 1: all 256 updates run as ONE scanned
-        # dispatch per iteration
+        # dispatch per iteration; small nets keep the test fast (the
+        # SAC-standard 256x256 default needs more steps to take off)
         .training(learning_starts=512, batch_size=128,
-                  num_updates_per_iter=256)
+                  num_updates_per_iter=256, hiddens=(64, 64))
         .debugging(seed=0)
     )
     algo = cfg.build_algo()
