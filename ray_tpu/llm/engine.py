@@ -49,6 +49,16 @@ class EngineConfig:
     # requests needing host sampling (top_k, per-request seed) fall
     # back to single-step ticks. 1 disables.
     decode_chunk: int = 8
+    # max prefills fused into one dispatch (power-of-two groups).
+    # 1 = one dispatch per admission (default: measured faster when
+    # requests trickle in — larger groups delay decode ticks between
+    # chunks); raise it for bursty admission patterns on hardware
+    # where prefill compute, not dispatch latency, dominates.
+    prefill_batch: int = 1
+    # compile the batched-prefill shapes (sizes up to prefill_batch per
+    # bucket) at engine start instead of on first traffic — serving
+    # deployments should pay compiles at boot, not as p95 TTFT spikes
+    precompile_prefill: bool = False
 
     def effective_prefill_buckets(self) -> tuple:
         """Paged layouts admit only page-aligned buckets; prefill
@@ -224,6 +234,30 @@ class LLMEngine:
 
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
+        # batched prefill: one dispatch per same-bucket admission group
+        def prefill_batch(params, cacheB, tokens, true_lens):
+            zeros = jnp.zeros((tokens.shape[0],), dtype=jnp.int32)
+            logits, cacheB = forward_cached(cfg, params, tokens, cacheB,
+                                            zeros)
+            last = logits[jnp.arange(tokens.shape[0]), true_lens - 1, :]
+            return last, cacheB
+
+        self._prefill_batch = jax.jit(prefill_batch, donate_argnums=(1,))
+        if self.paged:
+            from ..models.llama import write_prompts_to_pages
+
+            self._write_pages_batch = jax.jit(
+                write_prompts_to_pages, donate_argnums=(0,))
+        else:
+            def scatter_slots(cache, cacheB, idx):
+                return {
+                    "k": cache["k"].at[:, idx].set(cacheB["k"]),
+                    "v": cache["v"].at[:, idx].set(cacheB["v"]),
+                }
+
+            self._scatter_slots = jax.jit(scatter_slots,
+                                          donate_argnums=(0,))
+
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         # head-of-line request whose page reservation is pending: retried
         # before the queue so big requests aren't starved by later small
@@ -232,8 +266,48 @@ class LLMEngine:
         self._next_rid = 0
         self._rid_lock = threading.Lock()
         self._stop = threading.Event()
+        self._precompiled = threading.Event()
+        if self.ecfg.precompile_prefill:
+            # background: blocking the constructor would starve the
+            # replica's health checks and get it killed mid-boot.
+            # Callers gate traffic on is_ready() (LLMServer.ready) so
+            # steady-state serving never races compiles for the chip.
+            threading.Thread(target=self._precompile_prefill_shapes,
+                             daemon=True).start()
+        else:
+            self._precompiled.set()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def is_ready(self) -> bool:
+        return self._precompiled.is_set()
+
+    def wait_ready(self, timeout: float = 600.0) -> bool:
+        return self._precompiled.wait(timeout)
+
+    def _precompile_prefill_shapes(self):
+        """Compile every batched-prefill shape (sizes 1/2/4 x buckets)
+        so steady-state serving traffic never hits a cold compile
+        (early traffic may still warm a shape first)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.llama import init_cache
+
+        for bucket in self.ecfg.prefill_buckets:
+            if bucket > self.ecfg.max_seq_len:
+                continue
+            for bp in (1, 2, 4):
+                if bp > min(self.ecfg.max_batch_size,
+                            max(1, self.ecfg.prefill_batch)):
+                    break
+                cacheB = init_cache(self.cfg, bp, self.ecfg.max_seq_len)
+                self._prefill_batch(
+                    self.params, cacheB,
+                    jnp.zeros((bp, bucket), jnp.int32),
+                    jnp.ones((bp,), jnp.int32),
+                )
+        self._precompiled.set()
 
     # ------------------------------------------------------------------
     # public API
@@ -467,6 +541,7 @@ class LLMEngine:
     def _admit(self) -> bool:
         jnp = self._jnp
         admitted = False
+        to_prefill: list = []
         for i in range(self.ecfg.max_batch_size):
             if self.slots[i] is not None:
                 continue
@@ -530,39 +605,111 @@ class LLMEngine:
                 admitted = True
                 self._maybe_finish(i)
                 continue
-            tokens = np.zeros((1, bucket), dtype=np.int32)
-            tokens[0, : len(req.prompt)] = req.prompt
+            to_prefill.append((i, req, bucket))
+            self.slots[i] = req  # reserve the slot now
+            admitted = True
+        if to_prefill:
+            self._prefill_groups(to_prefill)
+        return admitted
+
+    def _prefill_groups(self, to_prefill):
+        """Prefill admitted requests grouped by bucket: ONE forward
+        dispatch (and one KV scatter) per group instead of one per
+        request (the reference gets this from vLLM's batched prefill;
+        on dispatch-latency-bound backends it's the admission
+        bottleneck)."""
+        jnp = self._jnp
+        groups: Dict[int, list] = {}
+        for item in to_prefill:
+            groups.setdefault(item[2], []).append(item)
+        # quantize group sizes to powers of two (7 -> 4+2+1): every
+        # distinct (size, bucket) shape is a separate XLA compile, so
+        # arbitrary sizes would stall the data plane on fresh compiles
+        # mid-traffic
+        quantized: list = []
+        for bucket, items in groups.items():
+            pos = 0
+            while pos < len(items):
+                take = 1 << ((len(items) - pos).bit_length() - 1)
+                take = min(take, max(1, self.ecfg.prefill_batch))
+                quantized.append((bucket, items[pos:pos + take]))
+                pos += take
+        for bucket, items in quantized:
+            Bp = len(items)
+            if Bp == 1:
+                # singleton: the original single-prefill path (identical
+                # cost profile to pre-batching behavior)
+                self._prefill_one(*items[0])
+                continue
+            tokens = np.zeros((Bp, bucket), dtype=np.int32)
+            true_lens = np.zeros((Bp,), dtype=np.int32)
+            for j, (_i, req, _b) in enumerate(items):
+                tokens[j, : len(req.prompt)] = req.prompt
+                true_lens[j] = len(req.prompt)
             from ..models.llama import init_cache
 
-            cache1 = init_cache(self.cfg, 1, self.ecfg.max_seq_len)
-            last_logits, cache1 = self._prefill(
-                self.params, cache1, jnp.asarray(tokens),
-                np.int32(len(req.prompt)),
+            cacheB = init_cache(self.cfg, Bp, self.ecfg.max_seq_len)
+            last_logits, cacheB = self._prefill_batch(
+                self.params, cacheB, jnp.asarray(tokens),
+                jnp.asarray(true_lens),
             )
             if self.paged:
                 ps = self.ecfg.page_size
                 nb = bucket // ps
-                rows = jnp.asarray(self._slot_pages[i][:nb],
-                                   dtype=jnp.int32)
+                rows = np.stack([
+                    np.asarray(self._slot_pages[i][:nb], dtype=np.int32)
+                    for i, _r, _b in items
+                ])
                 sliced = {
-                    "k": cache1["k"][:, :, :bucket],
-                    "v": cache1["v"][:, :, :bucket],
+                    "k": cacheB["k"][:, :, :bucket],
+                    "v": cacheB["v"][:, :, :bucket],
                 }
-                self.pages = self._write_pages(self.pages, sliced, rows)
+                self.pages = self._write_pages_batch(
+                    self.pages, sliced, jnp.asarray(rows))
             else:
-                # scatter the prefilled row into the shared cache, slot i
-                self.cache = {
-                    "k": self.cache["k"].at[:, i].set(cache1["k"][:, 0]),
-                    "v": self.cache["v"].at[:, i].set(cache1["v"][:, 0]),
-                }
-            self.lengths[i] = len(req.prompt)
-            tok = self._sample(np.asarray(last_logits), req.params)
-            req.generated.append(int(tok))
-            req.first_token_time = time.time()
-            self.slots[i] = req
-            admitted = True
-            self._maybe_finish(i)
-        return admitted
+                idx = jnp.asarray([i for i, _r, _b in items],
+                                  dtype=jnp.int32)
+                self.cache = self._scatter_slots(
+                    self.cache, cacheB, idx)
+            logits_np = np.asarray(last_logits)
+            now = time.time()
+            for j, (i, req, _b) in enumerate(items):
+                self.lengths[i] = len(req.prompt)
+                tok = self._sample(logits_np[j], req.params)
+                req.generated.append(int(tok))
+                req.first_token_time = now
+                self._maybe_finish(i)
+
+    def _prefill_one(self, i, req, bucket):
+        jnp = self._jnp
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, : len(req.prompt)] = req.prompt
+        from ..models.llama import init_cache
+
+        cache1 = init_cache(self.cfg, 1, self.ecfg.max_seq_len)
+        last_logits, cache1 = self._prefill(
+            self.params, cache1, jnp.asarray(tokens),
+            np.int32(len(req.prompt)),
+        )
+        if self.paged:
+            ps = self.ecfg.page_size
+            nb = bucket // ps
+            rows = jnp.asarray(self._slot_pages[i][:nb], dtype=jnp.int32)
+            sliced = {
+                "k": cache1["k"][:, :, :bucket],
+                "v": cache1["v"][:, :, :bucket],
+            }
+            self.pages = self._write_pages(self.pages, sliced, rows)
+        else:
+            self.cache = {
+                "k": self.cache["k"].at[:, i].set(cache1["k"][:, 0]),
+                "v": self.cache["v"].at[:, i].set(cache1["v"][:, 0]),
+            }
+        self.lengths[i] = len(req.prompt)
+        tok = self._sample(np.asarray(last_logits), req.params)
+        req.generated.append(int(tok))
+        req.first_token_time = time.time()
+        self._maybe_finish(i)
 
     def _reserve_pages(self, i: int, req: "_Request", bucket: int) -> bool:
         """Allocate exactly the pages this request can ever touch:

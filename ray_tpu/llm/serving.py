@@ -42,6 +42,11 @@ class LLMServer:
 
             self.tokenizer = AutoTokenizer.from_pretrained(tokenizer)
 
+    def ready(self) -> bool:
+        """True once boot-time compiles finished (gate traffic on it;
+        see EngineConfig.precompile_prefill)."""
+        return self.engine.is_ready()
+
     def _encode(self, prompt) -> List[int]:
         if isinstance(prompt, list):
             return [int(t) for t in prompt]

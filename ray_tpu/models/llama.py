@@ -538,6 +538,27 @@ def write_prompt_to_pages(
     }
 
 
+def write_prompts_to_pages(
+    pages: Dict[str, jax.Array],
+    prefill_cache: Dict[str, jax.Array],  # [L, B, S_bucket, Hkv, D]
+    page_rows: jax.Array,  # [B, S_bucket // page_size] physical pages
+) -> Dict[str, jax.Array]:
+    """Batched write_prompt_to_pages: one scatter covers a whole
+    same-bucket prefill group."""
+    L, B, S, Hkv, D = prefill_cache["k"].shape
+    ps = pages["k"].shape[3]
+    nb = S // ps
+    k_rows = prefill_cache["k"].reshape(
+        L, B * nb, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    v_rows = prefill_cache["v"].reshape(
+        L, B * nb, ps, Hkv, D).transpose(0, 3, 1, 2, 4)
+    flat = page_rows.reshape(-1)  # [B*nb]
+    return {
+        "k": pages["k"].at[:, :, flat].set(k_rows),
+        "v": pages["v"].at[:, :, flat].set(v_rows),
+    }
+
+
 def loss_fn(
     cfg: LlamaConfig,
     params: Dict[str, Any],

@@ -52,6 +52,15 @@ def main():
     ), name="llm", route_prefix="/llm", http_port=http_port)
     url = f"http://127.0.0.1:{http_port}/llm"
 
+    # gate on boot-time compiles: measure steady-state serving, not the
+    # one-time jit warmup (production deployments do the same)
+    handle = serve.get_deployment_handle("LLMServer")
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if handle.options(method_name="ready").remote().result(60):
+            break
+        time.sleep(2.0)
+
     rng = np.random.default_rng(0)
     prompt = [int(x) for x in rng.integers(1, 500, args.prompt_len)]
     payload = json.dumps({
@@ -100,6 +109,8 @@ def main():
         t.join()
     elapsed = time.perf_counter() - t_start
 
+    if errors and not results:
+        sys.exit(f"all {len(errors)} requests failed; first: {errors[0]}")
     walls = sorted(r[0] for r in results)
     ttfts = sorted(r[1] for r in results)
     toks = sum(r[2] for r in results)
