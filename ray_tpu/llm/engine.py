@@ -286,21 +286,32 @@ class LLMEngine:
         return self._precompiled.wait(timeout)
 
     def _precompile_prefill_shapes(self):
-        """Compile every batched-prefill shape (sizes 1/2/4 x buckets)
-        so steady-state serving traffic never hits a cold compile
-        (early traffic may still warm a shape first)."""
-        import jax
+        """Compile the prefill FORWARD shapes the engine will actually
+        dispatch: the singleton path per bucket, plus each power-of-two
+        group size up to prefill_batch. Throwaway caches only — live
+        cache/pages state is never donated from this thread (the
+        scheduler loop runs concurrently). The small KV-scatter
+        compiles still happen on first use; forwards dominate."""
         import jax.numpy as jnp
 
         from ..models.llama import init_cache
 
+        sizes = [1]
+        b = 2
+        cap = 1 << (max(1, self.ecfg.prefill_batch).bit_length() - 1)
+        while b <= min(self.ecfg.max_batch_size, cap):
+            sizes.append(b)
+            b *= 2
         for bucket in self.ecfg.prefill_buckets:
             if bucket > self.ecfg.max_seq_len:
                 continue
-            for bp in (1, 2, 4):
-                if bp > min(self.ecfg.max_batch_size,
-                            max(1, self.ecfg.prefill_batch)):
-                    break
+            # singleton groups run the single-prefill jit
+            cache1 = init_cache(self.cfg, 1, self.ecfg.max_seq_len)
+            self._prefill(
+                self.params, cache1,
+                jnp.zeros((1, bucket), jnp.int32), np.int32(1),
+            )
+            for bp in sizes[1:]:
                 cacheB = init_cache(self.cfg, bp, self.ecfg.max_seq_len)
                 self._prefill_batch(
                     self.params, cacheB,
@@ -631,7 +642,11 @@ class LLMEngine:
             pos = 0
             while pos < len(items):
                 take = 1 << ((len(items) - pos).bit_length() - 1)
-                take = min(take, max(1, self.ecfg.prefill_batch))
+                # cap is rounded DOWN to a power of two: every shape
+                # dispatched here must be in the precompiled set
+                cap = 1 << (max(1, self.ecfg.prefill_batch)
+                            .bit_length() - 1)
+                take = min(take, cap)
                 quantized.append((bucket, items[pos:pos + take]))
                 pos += take
         for bucket, items in quantized:

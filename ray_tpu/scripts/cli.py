@@ -258,6 +258,43 @@ def cmd_jobs(args):
         print("stopped" if ok else "not running")
 
 
+def cmd_list(args):
+    """State API listing (reference: `ray list ...`,
+    util/state/state_cli.py)."""
+    import json as _json
+
+    import ray_tpu as ray
+    from ray_tpu.util import state
+
+    host, port = _resolve_address(args)
+    ray.init(address=f"{host}:{port}")
+    fn = {
+        "actors": state.list_actors,
+        "tasks": state.list_tasks,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "workers": state.list_workers,
+        "placement_groups": state.list_placement_groups,
+        "jobs": state.list_jobs,
+    }[args.entity]
+    rows = fn()[: args.limit]
+    if args.format == "json":
+        print(_json.dumps(rows, indent=2, default=str))
+        return
+    if not rows:
+        print(f"no {args.entity}")
+        return
+    cols = list(rows[0].keys())
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))[:40]) for r in rows))
+        for c in cols
+    }
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(
+            str(r.get(c, ""))[:40].ljust(widths[c]) for c in cols))
+
+
 def cmd_memory(args):
     """Object-store usage per node (reference: `ray memory`,
     scripts.py:2084)."""
@@ -361,6 +398,16 @@ def build_parser() -> argparse.ArgumentParser:
     js = jsub.add_parser("stop")
     js.add_argument("job_id")
     s.set_defaults(fn=cmd_jobs)
+
+    s = sub.add_parser("list", help="list cluster entities (state API)")
+    s.add_argument("entity", choices=[
+        "actors", "tasks", "nodes", "objects", "workers",
+        "placement_groups", "jobs"])
+    s.add_argument("--address")
+    s.add_argument("--format", choices=["table", "json"],
+                   default="table")
+    s.add_argument("--limit", type=int, default=100)
+    s.set_defaults(fn=cmd_list)
 
     s = sub.add_parser("memory", help="object store contents per node")
     s.add_argument("--address")

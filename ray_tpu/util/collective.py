@@ -1,0 +1,103 @@
+"""ray.util.collective-compatible surface.
+
+Reference: python/ray/util/collective/collective.py — GroupManager
+(:60), init_collective_group (:150), allreduce (:295) with NCCL/Gloo
+backends. Here the DEVICE plane is jax collectives inside pjit/shard_map
+programs (parallel/collectives.py — allreduce/allgather/all_to_all as
+`lax` wrappers over mesh axes), so this module provides the HOST-plane
+group API with the reference's names: named groups, barrier, and
+object/array collectives over the GCS KV rendezvous (the Gloo-analogue
+control plane; reference: gloo_collective_group.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..parallel.collectives import HostCollectiveGroup
+
+_groups: Dict[str, HostCollectiveGroup] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Reference: collective.py:150 — every participant calls this with
+    its rank before using the group."""
+    if backend not in ("host", "gloo", "cpu"):
+        raise ValueError(
+            f"backend {backend!r} not supported: device-plane "
+            "collectives are jax ops inside pjit programs "
+            "(ray_tpu.parallel.collectives); host groups use 'host'")
+    _groups[group_name] = HostCollectiveGroup(
+        group_name, world_size=world_size, rank=rank)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        g.teardown()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
+
+
+def barrier(group_name: str = "default", timeout: float = 120.0) -> None:
+    _groups[group_name].barrier(timeout=timeout)
+
+
+def allreduce(tensor: np.ndarray, group_name: str = "default",
+              op: str = "sum", timeout: float = 120.0) -> np.ndarray:
+    """Array allreduce through the host plane; returns the reduced
+    array (the reference mutates in place — numpy arrays here are
+    copied on gather, so the result is returned AND written back when
+    the input is writable)."""
+    g = _groups[group_name]
+    parts = g.allgather_obj(np.asarray(tensor), timeout=timeout)
+    stacked = np.stack(parts)
+    if op == "sum":
+        out = stacked.sum(axis=0)
+    elif op == "max":
+        out = stacked.max(axis=0)
+    elif op == "min":
+        out = stacked.min(axis=0)
+    elif op in ("mean", "avg"):
+        out = stacked.mean(axis=0)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+    try:
+        tensor[...] = out
+    except (TypeError, ValueError):
+        pass
+    return out
+
+
+def allgather(tensor: np.ndarray, group_name: str = "default",
+              timeout: float = 120.0) -> list:
+    return _groups[group_name].allgather_obj(
+        np.asarray(tensor), timeout=timeout)
+
+
+def broadcast(tensor: Any, src_rank: int = 0,
+              group_name: str = "default",
+              timeout: float = 120.0) -> Any:
+    g = _groups[group_name]
+    value = tensor if g.rank == src_rank else None
+    return g.broadcast_obj(value, root=src_rank, timeout=timeout)
+
+
+def reduce(tensor: np.ndarray, dst_rank: int = 0,
+           group_name: str = "default", op: str = "sum",
+           timeout: float = 120.0) -> Optional[np.ndarray]:
+    out = allreduce(tensor, group_name, op, timeout)
+    return out if _groups[group_name].rank == dst_rank else None

@@ -52,8 +52,9 @@ def main():
     ), name="llm", route_prefix="/llm", http_port=http_port)
     url = f"http://127.0.0.1:{http_port}/llm"
 
-    # gate on boot-time compiles: measure steady-state serving, not the
-    # one-time jit warmup (production deployments do the same)
+    # readiness gate (no-op unless the engine config enables
+    # precompile_prefill; kept so config changes don't silently measure
+    # cold compiles)
     handle = serve.get_deployment_handle("LLMServer")
     deadline = time.time() + 600
     while time.time() < deadline:

@@ -190,3 +190,42 @@ def test_export_events_written(ray_start):
     assert "NODE_ADDED" in types
     assert "ACTOR_REGISTERED" in types
     assert "ACTOR_ALIVE" in types
+
+
+def test_collective_compat_surface(ray_start):
+    """ray.util.collective-shaped host-plane API (reference:
+    util/collective/collective.py)."""
+    import threading
+
+    import numpy as np
+
+    from ray_tpu.util import collective as col
+
+    from ray_tpu.parallel.collectives import HostCollectiveGroup
+
+    out = {}
+
+    def rank1():
+        # the registry is per-process (like the reference's
+        # GroupManager), so the second in-process rank drives the
+        # underlying group object directly
+        g = HostCollectiveGroup("compat", world_size=2, rank=1)
+        g.barrier(timeout=60)
+        parts = g.allgather_obj(np.ones(4, np.float32), timeout=60)
+        out["r1"] = np.stack(parts).sum(axis=0)
+        out["b1"] = g.broadcast_obj(None, root=0, timeout=60)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    col.init_collective_group(2, 0, group_name="compat")
+    assert col.get_rank(group_name="compat") == 0
+    assert col.get_collective_group_size(group_name="compat") == 2
+    col.barrier(group_name="compat")
+    mine = np.full(4, 2.0, np.float32)
+    reduced = col.allreduce(mine, group_name="compat")
+    got = col.broadcast({"cfg": 7}, src_rank=0, group_name="compat")
+    t.join(timeout=60)
+    np.testing.assert_array_equal(reduced, np.full(4, 3.0, np.float32))
+    np.testing.assert_array_equal(out["r1"], reduced)
+    assert got == {"cfg": 7} and out["b1"] == {"cfg": 7}
+    col.destroy_collective_group("compat")
