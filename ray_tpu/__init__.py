@@ -10,6 +10,7 @@ from ._private.core_worker import (  # noqa: F401
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,
+    ObjectRefGenerator,
     RayActorError,
     RayError,
     RayTaskError,

@@ -303,7 +303,7 @@ class _CallerQueue:
 
 class _TaskRecord:
     __slots__ = ("spec", "retries_left", "status", "return_ids", "is_actor",
-                 "retained")
+                 "retained", "stream")
 
     def __init__(self, spec: dict, retries_left: int, return_ids,
                  retained=()):
@@ -314,6 +314,9 @@ class _TaskRecord:
         self.is_actor = False
         # ObjectIDs pinned while this task is in flight (arg references)
         self.retained = list(retained)
+        # streaming-generator state (num_returns="streaming"):
+        # {"count": items arrived, "total": None until end, "error"}
+        self.stream: Optional[dict] = None
 
 
 class CoreWorker:
@@ -512,6 +515,8 @@ class CoreWorker:
         s = self._server
         s.register_method("get_object_info", self._rpc_get_object_info)
         s.register_method("add_borrower", self._rpc_add_borrower)
+        s.register_method("report_stream_items",
+                          self._rpc_report_stream_items)
         s.register_method("remove_borrower", self._rpc_remove_borrower)
         s.register_method("add_borrowers", self._rpc_add_borrowers)
         s.register_method("remove_borrowers", self._rpc_remove_borrowers)
@@ -1189,7 +1194,8 @@ class CoreWorker:
         from ..util import tracing as _tracing
 
         _tracing.stamp_spec(spec)
-        return_ids = [
+        streaming = num_returns == "streaming"
+        return_ids = [] if streaming else [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
         for r in arg_refs:
@@ -1202,16 +1208,24 @@ class CoreWorker:
                 # completing before the ref exists can't free the record
                 rec.local_refs = 1
                 self._records[oid.binary()] = rec
-            self._tasks[task_id.binary()] = _TaskRecord(
-                spec, max_retries, [o.binary() for o in return_ids],
+            trec = _TaskRecord(
+                spec, 0 if streaming else max_retries,
+                [o.binary() for o in return_ids],
                 retained=[r.id for r in arg_refs],
             )
+            if streaming:
+                # generator tasks don't retry (partially-consumed
+                # streams can't replay); items append as they arrive
+                trec.stream = {"count": 0, "total": None, "error": None}
+            self._tasks[task_id.binary()] = trec
         self._record_task_event(spec, "PENDING")
         self._count("ray_tpu_tasks_submitted_total",
                     "tasks submitted by this worker")
         pool = self._lease_pool(demand, strategy, strategy_params,
                                 runtime_env)
         pool.enqueue(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return [
             ObjectRef(oid, self.address, _register=False)
             for oid in return_ids
@@ -1292,7 +1306,8 @@ class CoreWorker:
                 self._sched_classes[key] = pool
             return pool
 
-    def _on_task_done(self, spec: dict, returns: List[tuple], node_id: str):
+    def _on_task_done(self, spec: dict, returns: List[tuple], node_id: str,
+                      stream_error=None):
         """Submitter callback with the executor's reply. Idempotent: a
         streamed per-task completion (report_task_done) and the batch
         reply may both carry the same result."""
@@ -1303,6 +1318,13 @@ class CoreWorker:
                 if task.status in ("FINISHED", "FAILED"):
                     return
                 task.status = "FINISHED"
+                if task.stream is not None:
+                    # the executor awaited every item report before
+                    # replying, so count is complete here
+                    if stream_error is not None:
+                        task.stream["error"] = stream_error
+                    elif task.stream["total"] is None:
+                        task.stream["total"] = task.stream["count"]
         if task is not None:
             retained, task.retained = task.retained, []
             for oid in retained:
@@ -1339,6 +1361,20 @@ class CoreWorker:
             done = self._tasks.get(task_id)
             if done is not None and done.status == "FINISHED":
                 return False  # result already streamed before the failure
+            if done is not None and done.stream is not None:
+                done.stream["error"] = serialization.dumps(
+                    RayTaskError(f"streaming task failed: {error}",
+                                 type(error).__name__))
+                done.status = "FAILED"
+                retained, done.retained = done.retained, []
+        if done is not None and done.stream is not None:
+            for oid in retained:
+                self._release_ref(oid)
+            self._notify_ready()
+            self._record_task_event(spec, "FAILED")
+            self._count("ray_tpu_tasks_failed_total",
+                        "task attempts that failed")
+            return False
         self._count("ray_tpu_tasks_failed_total",
                     "task attempts that failed")
         with self._records_lock:
@@ -1553,6 +1589,9 @@ class CoreWorker:
                          type(e).__name__)
         )
         task_id = TaskID(spec["task_id"])
+        if spec.get("num_returns") == "streaming":
+            return {"returns": [], "stream_error": err,
+                    "node_id": self.node_id}
         return {
             "returns": [
                 (ObjectID.for_task_return(task_id, i).binary(), "err",
@@ -1593,8 +1632,13 @@ class CoreWorker:
                 self._task_executor, run_one, spec
             )
             results.append(res)
-            reporter.add(spec["task_id"], res["returns"],
-                         spec["owner_address"])
+            if spec.get("num_returns") != "streaming":
+                # streaming tasks have their own delivery channel and a
+                # stream_error field only the batch reply carries — a
+                # report_tasks_done completion would mark them FINISHED
+                # early and swallow a later stream_error
+                reporter.add(spec["task_id"], res["returns"],
+                             spec["owner_address"])
         reporter.close()  # unflushed tail rides the reply
         return {"results": results, "node_id": self.node_id}
 
@@ -1630,6 +1674,7 @@ class CoreWorker:
 
     def _execute_task(self, spec: dict):
         self._set_log_job(spec)
+        streaming = spec.get("num_returns") == "streaming"
         try:
             func = self._load_function(spec)
             args = [self._unpack_arg(a) for a in spec["args"]]
@@ -1637,6 +1682,9 @@ class CoreWorker:
             from ..util import tracing
 
             with tracing.task_span(spec, self):
+                if streaming:
+                    return self._execute_streaming(spec, func, args,
+                                                   kwargs)
                 result = func(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — shipped to the owner
             tb = traceback.format_exc()
@@ -1644,6 +1692,9 @@ class CoreWorker:
                 RayTaskError(f"{type(e).__name__}: {e}\n{tb}", type(e).__name__)
             )
             task_id = TaskID(spec["task_id"])
+            if streaming:
+                return {"returns": [], "stream_error": err,
+                        "node_id": self.node_id}
             return {
                 "returns": [
                     (
@@ -1687,17 +1738,115 @@ class CoreWorker:
                 )
             return out
         for i, value in enumerate(values):
-            oid = ObjectID.for_task_return(task_id, i)
-            meta, buffers = serialization.serialize(value)
-            size = serialization.serialized_size(meta, buffers)
-            if size <= self._cfg.max_inline_object_size:
-                buf = bytearray(size)
-                serialization.write_into(memoryview(buf), meta, buffers)
-                out.append((oid.binary(), "inline", bytes(buf)))
-            else:
-                self._write_shm(oid, meta, buffers, size)
-                out.append((oid.binary(), "shm", {"size": size}))
+            out.append(self._pack_one_return(task_id, i, value))
         return out
+
+    def _execute_streaming(self, spec: dict, func, args, kwargs):
+        """Run a generator task, shipping each yielded item to the
+        owner AS PRODUCED (reference: streaming generators,
+        _raylet.pyx ObjectRefGenerator execution). Every item report is
+        awaited before the final reply, so the owner has the complete
+        stream when the task completes."""
+        import inspect
+
+        result = func(*args, **kwargs)
+        if not inspect.isgenerator(result):
+            raise TypeError(
+                'num_returns="streaming" requires a generator function')
+        task_id = TaskID(spec["task_id"])
+        owner = tuple(spec["owner_address"])
+        cli = self._pool.get(*owner)
+        loop = EventLoopThread.get()
+        pending = []
+        buf: List[tuple] = []
+        last_send = time.monotonic()
+
+        def flush():
+            nonlocal buf, last_send
+            if not buf:
+                return
+            batch, buf = buf, []
+            last_send = time.monotonic()
+            pending.append(loop.spawn(cli.call(
+                "report_stream_items",
+                task_id=spec["task_id"],
+                items=batch,
+                node_id=self.node_id,
+            )))
+
+        def drain():
+            flush()
+            for fut in pending:
+                fut.result(timeout=60)
+
+        try:
+            for idx, value in enumerate(result):
+                buf.append((idx,
+                            self._pack_one_return(task_id, idx, value)))
+                # coalesce fast producers; slow ones ship per item
+                if len(buf) >= 32 or                         time.monotonic() - last_send >= 0.005:
+                    flush()
+        except Exception:
+            # items yielded BEFORE the failure must land before the
+            # error reply — __next__ drains buffered items first, and
+            # an abandoned in-flight report would leak its pre-biased
+            # record on the owner
+            try:
+                drain()
+            except Exception:
+                pass
+            raise
+        # all items must land before the reply (the reply finalizes the
+        # stream's total on the owner)
+        drain()
+        return {"returns": [], "node_id": self.node_id}
+
+    async def _rpc_report_stream_items(self, task_id: bytes, items,
+                                       node_id: str):
+        """Owner service: install streamed generator items as owned
+        objects as they arrive."""
+        with self._records_lock:
+            task = self._tasks.get(task_id)
+            if task is None or task.stream is None:
+                return True
+            stream = task.stream
+            arrived = stream.setdefault("arrived", set())
+            for idx, (oid_bytes, kind, payload) in items:
+                if oid_bytes in self._records:
+                    continue  # duplicate delivery
+                rec = _ObjectRecord()
+                rec.pending = False
+                # pre-biased for the ref the generator will hand out;
+                # unconsumed items release on generator GC
+                rec.local_refs = 1
+                if kind == "inline":
+                    self.memory_store.put(ObjectID(oid_bytes),
+                                          serialization.loads(payload))
+                elif kind == "shm":
+                    rec.size = payload["size"]
+                    rec.locations.add(node_id)
+                elif kind == "err":
+                    rec.error = payload
+                rec.event.set()
+                self._records[oid_bytes] = rec
+                arrived.add(idx)
+            # expose only the contiguous prefix: consumers index in order
+            while stream["count"] in arrived:
+                arrived.discard(stream["count"])
+                stream["count"] += 1
+        self._notify_ready()
+        return True
+
+    def _pack_one_return(self, task_id: TaskID, index: int, value):
+        oid = ObjectID.for_task_return(task_id, index)
+        meta, buffers = serialization.serialize(value)
+        size = serialization.serialized_size(meta, buffers)
+        if size <= self._cfg.max_inline_object_size:
+            buf = bytearray(size)
+            serialization.write_into(memoryview(buf), meta, buffers)
+            return (oid.binary(), "inline", bytes(buf))
+        self._write_shm(oid, meta, buffers, size)
+        return (oid.binary(), "shm", {"size": size})
 
     def _unpack_arg(self, packed):
         kind = packed[0]
@@ -2417,6 +2566,72 @@ class CoreWorker:
 # Lease pool: one per scheduling class (reference: NormalTaskSubmitter's
 # per-SchedulingKey lease management, normal_task_submitter.h:79)
 # ---------------------------------------------------------------------------
+class ObjectRefGenerator:
+    """Iterator over a streaming task's return refs (reference:
+    _raylet.pyx:288 ObjectRefGenerator — `num_returns="streaming"`
+    tasks yield objects consumed incrementally while the task still
+    runs). Each __next__ blocks until the next yielded item's object
+    is available, then returns its ObjectRef."""
+
+    def __init__(self, task_id: TaskID, worker: "CoreWorker"):
+        self._task_id = task_id
+        self._worker = worker
+        self._next = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        w = self._worker
+        tid = self._task_id.binary()
+        while True:
+            with w._records_lock:
+                task = w._tasks.get(tid)
+                stream = task.stream if task is not None else None
+                if stream is None:
+                    raise StopIteration
+                if self._next < stream["count"]:
+                    self._next += 1
+                    oid = ObjectID.for_task_return(
+                        self._task_id, self._next - 1)
+                    # record was pre-biased at arrival for this hand-off
+                    break
+                # buffered items drain BEFORE a mid-stream failure
+                # surfaces: everything yielded before the error is valid
+                if stream["error"] is not None:
+                    err = stream["error"]
+                    raise serialization.loads(err)
+                if (stream["total"] is not None
+                        and self._next >= stream["total"]):
+                    raise StopIteration
+            with w._ready_cv:
+                w._ready_cv.wait(0.05)
+        return ObjectRef(oid, w.address, _register=False)
+
+    def __del__(self):
+        # release the pre-bias of items never consumed, and drop the
+        # stream record so a half-read stream doesn't pin its tail
+        w = self._worker
+        if w is None:
+            return
+        try:
+            tid = self._task_id.binary()
+            with w._records_lock:
+                task = w._tasks.get(tid)
+                stream = task.stream if task is not None else None
+                count = stream["count"] if stream else 0
+                if task is not None:
+                    # late-arriving items must not install pre-biased
+                    # records nothing will release: the report handler
+                    # skips tasks without a live stream
+                    task.stream = None
+            for idx in range(self._next, count):
+                oid = ObjectID.for_task_return(self._task_id, idx)
+                w.remove_local_ref(oid)
+        except Exception:
+            pass
+
+
 class _LogTee:
     """stdout/stderr wrapper on workers: passes writes through to the
     original stream (the raylet's per-worker log file) and buffers
@@ -2811,7 +3026,8 @@ class _LeasePool:
             asyncio.ensure_future(self._pump())
             return
         for spec, res in zip(specs, reply["results"]):
-            w._on_task_done(spec, res["returns"], reply["node_id"])
+            w._on_task_done(spec, res["returns"], reply["node_id"],
+                            stream_error=res.get("stream_error"))
         with self.lock:
             # SPREAD leases are single-use: reuse would pin the whole burst
             # to whichever node answered first (reference: spread policy
@@ -3085,7 +3301,8 @@ class _ActorSubmitter:
             return
         self._abandoned.difference_update(sent_abandoned)
         for sp, res in zip(specs, reply["results"]):
-            w._on_task_done(sp, res["returns"], res["node_id"])
+            w._on_task_done(sp, res["returns"], res["node_id"],
+                            stream_error=res.get("stream_error"))
 
     async def _send(self, spec: dict):
         w = self.worker
@@ -3160,7 +3377,8 @@ class _ActorSubmitter:
                 )
             return
         self._abandoned.difference_update(sent_abandoned)
-        w._on_task_done(spec, reply["returns"], reply["node_id"])
+        w._on_task_done(spec, reply["returns"], reply["node_id"],
+                        stream_error=reply.get("stream_error"))
 
     def on_actor_event(self, event: dict):
         """Wired to the GCS ACTOR pubsub channel."""

@@ -12,6 +12,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 from ._private import node as _node_mod
 from ._private.core_worker import (
     CoreWorker,
+    ObjectRefGenerator,
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,

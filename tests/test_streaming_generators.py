@@ -1,0 +1,103 @@
+"""Streaming generator tests (num_returns="streaming").
+
+Reference analogues: python/ray/tests/test_streaming_generator.py —
+ObjectRefGenerator semantics: incremental consumption while the task
+runs, mid-stream errors, early termination.
+"""
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 4, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+def test_basic_stream(ray_start):
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray.get(ref, timeout=60) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_items_arrive_before_task_completes(ray_start):
+    @ray.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            yield i
+            time.sleep(0.8)
+
+    g = slow_gen.remote()
+    t0 = time.time()
+    first = ray.get(next(g), timeout=60)
+    first_latency = time.time() - t0
+    assert first == 0
+    # the task sleeps 3.2s total; the first item must arrive well
+    # before completion
+    assert first_latency < 2.0, first_latency
+    rest = [ray.get(r, timeout=60) for r in g]
+    assert rest == [1, 2, 3]
+
+
+def test_large_items_go_through_shm(ray_start):
+    import numpy as np
+
+    @ray.remote(num_returns="streaming")
+    def big_gen():
+        for i in range(3):
+            yield np.full(300_000, i, dtype=np.float32)  # > inline max
+
+    for i, ref in enumerate(big_gen.remote()):
+        arr = ray.get(ref, timeout=60)
+        assert arr.shape == (300_000,) and float(arr[0]) == float(i)
+
+
+def test_mid_stream_error(ray_start):
+    @ray.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise RuntimeError("stream blew up")
+
+    g = bad_gen.remote()
+    assert ray.get(next(g), timeout=60) == 1
+    assert ray.get(next(g), timeout=60) == 2
+    with pytest.raises(Exception, match="stream blew up"):
+        for _ in range(5):
+            next(g)  # error surfaces once the failure reply lands
+
+
+def test_non_generator_function_errors(ray_start):
+    @ray.remote(num_returns="streaming")
+    def not_gen():
+        return 42
+
+    g = not_gen.remote()
+    with pytest.raises(Exception, match="generator"):
+        next(g)
+
+
+def test_early_termination_no_hang(ray_start):
+    @ray.remote(num_returns="streaming")
+    def gen():
+        for i in range(50):
+            yield i
+
+    g = gen.remote()
+    assert ray.get(next(g), timeout=60) == 0
+    assert ray.get(next(g), timeout=60) == 1
+    del g  # abandon the rest; must not wedge the worker
+
+    @ray.remote
+    def probe():
+        return "alive"
+
+    assert ray.get(probe.remote(), timeout=60) == "alive"
