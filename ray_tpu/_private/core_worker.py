@@ -1530,7 +1530,8 @@ class CoreWorker:
         tensor_transport: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = TaskID.for_job(self.job_id)
-        return_ids = [
+        streaming = num_returns == "streaming"
+        return_ids = [] if streaming else [
             ObjectID.for_task_return(task_id, i) for i in range(num_returns)
         ]
         packed_args, packed_kwargs, arg_refs = self._pack_call_args(
@@ -1558,12 +1559,17 @@ class CoreWorker:
                 r = _ObjectRecord()
                 r.local_refs = 1  # pre-biased for the handed-back ref
                 self._records[oid.binary()] = r
-            rec = _TaskRecord(spec, max_task_retries,
+            rec = _TaskRecord(spec,
+                              0 if streaming else max_task_retries,
                               [o.binary() for o in return_ids],
                               retained=[r.id for r in arg_refs])
             rec.is_actor = True
+            if streaming:
+                rec.stream = {"count": 0, "total": None, "error": None}
             self._tasks[task_id.binary()] = rec
         self.actor_submitter(actor_id, max_task_retries).enqueue(spec)
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return [
             ObjectRef(oid, self.address, _register=False)
             for oid in return_ids
@@ -1747,9 +1753,12 @@ class CoreWorker:
         _raylet.pyx ObjectRefGenerator execution). Every item report is
         awaited before the final reply, so the owner has the complete
         stream when the task completes."""
+        result = func(*args, **kwargs)
+        return self._stream_result(spec, result)
+
+    def _stream_result(self, spec: dict, result):
         import inspect
 
-        result = func(*args, **kwargs)
         if not inspect.isgenerator(result):
             raise TypeError(
                 'num_returns="streaming" requires a generator function')
@@ -1938,8 +1947,9 @@ class CoreWorker:
                 )
             except Exception as e:  # noqa: BLE001
                 res = self._actor_error_reply(spec, e)
-            reporter.add(spec["task_id"], res["returns"],
-                         spec["owner_address"])
+            if spec.get("num_returns") != "streaming":
+                reporter.add(spec["task_id"], res["returns"],
+                             spec["owner_address"])
             return res
 
         results = await asyncio.gather(*[
@@ -2004,6 +2014,11 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
         self._set_log_job(spec)
         method = getattr(self.actor_instance, spec["method"], None)
+        if spec.get("num_returns") == "streaming" and \
+                asyncio.iscoroutinefunction(method):
+            return self._actor_error_reply(spec, TypeError(
+                'num_returns="streaming" supports sync generator '
+                "methods only"))
         if method is None:
             return self._actor_error_reply(
                 spec,
@@ -2046,6 +2061,11 @@ class CoreWorker:
             from ..util import tracing
 
             with tracing.task_span(spec, self):
+                if spec.get("num_returns") == "streaming":
+                    # generator actor method: items stream while the
+                    # ordered queue holds this seq slot until exhaustion
+                    return self._stream_result(
+                        spec, method(*args, **kwargs))
                 result = method(*args, **kwargs)
         except Exception as e:  # noqa: BLE001
             return self._actor_error_reply(spec, e)
@@ -3081,7 +3101,12 @@ class _ActorSubmitter:
 
     def enqueue(self, spec: dict):
         with self.lock:
-            spec.setdefault("_retries", self.max_task_retries)
+            # streaming generator calls never retry: a replay would
+            # re-run actor side effects and re-install released items
+            if spec.get("num_returns") == "streaming":
+                spec["_retries"] = 0
+            else:
+                spec.setdefault("_retries", self.max_task_retries)
             self.queue.append(spec)
         EventLoopThread.get().spawn(self._pump())
 
@@ -3215,6 +3240,24 @@ class _ActorSubmitter:
                 # Result already streamed via report_tasks_done before
                 # the batch transport failed — the call succeeded.
                 return
+            if spec.get("num_returns") == "streaming":
+                # covers abandoned generators too (stream already None)
+                if done is not None:
+                    if done.stream is not None:
+                        done.stream["error"] = err
+                    done.status = "FAILED"
+                    retained, done.retained = done.retained, []
+                else:
+                    retained = []
+        if spec.get("num_returns") == "streaming":
+            for oid in retained:
+                w._release_ref(oid)
+            w._notify_ready()
+            w._record_task_event(spec, "FAILED")
+            w._count("ray_tpu_tasks_failed_total",
+                     "task attempts that failed")
+            return
+        with w._records_lock:
             for i in range(spec["num_returns"]):
                 oid = ObjectID.for_task_return(task_id, i)
                 rec = w._records.get(oid.binary())

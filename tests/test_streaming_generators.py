@@ -101,3 +101,29 @@ def test_early_termination_no_hang(ray_start):
         return "alive"
 
     assert ray.get(probe.remote(), timeout=60) == "alive"
+
+
+def test_actor_streaming_method(ray_start):
+    @ray.remote
+    class Streamer:
+        def __init__(self):
+            self.calls = 0
+
+        def stream(self, n):
+            self.calls += 1
+            for i in range(n):
+                yield {"i": i, "call": self.calls}
+
+        def plain(self):
+            return self.calls
+
+    a = Streamer.remote()
+    g = a.stream.options(num_returns="streaming").remote(4)
+    out = [ray.get(r, timeout=60) for r in g]
+    assert [o["i"] for o in out] == [0, 1, 2, 3]
+    # ordered queue: the following plain call ran after the stream
+    assert ray.get(a.plain.remote(), timeout=60) == 1
+    # second stream call sees updated actor state
+    g2 = a.stream.options(num_returns="streaming").remote(2)
+    out2 = [ray.get(r, timeout=60)["call"] for r in g2]
+    assert out2 == [2, 2]
