@@ -174,7 +174,7 @@ class Decoder {
           break;
         case '.':  // STOP
           if (stack_.empty()) throw std::runtime_error("pickle: empty");
-          return stack_.back();
+          return Top();
         case 'N':
           Push(Value());
           break;
@@ -250,19 +250,19 @@ class Decoder {
           break;
         }
         case 0x94:  // MEMOIZE (implicit next index)
-          memo_.push_back(stack_.back());
+          memo_.push_back(Top());
           break;
         case 'q': {  // BINPUT
           size_t i = Next();
           if (memo_.size() <= i) memo_.resize(i + 1);
-          memo_[i] = stack_.back();
+          memo_[i] = Top();
           break;
         }
         case 'r': {  // LONG_BINPUT
           uint32_t i;
           ReadRaw(&i, 4);
           if (memo_.size() <= i) memo_.resize(i + 1);
-          memo_[i] = stack_.back();
+          memo_[i] = Top();
           break;
         }
         case 'h':  // BINGET
@@ -285,7 +285,7 @@ class Decoder {
           break;
         case 'a': {  // APPEND (single)
           Value item = Pop();
-          ValueList base = stack_.back().as_list();
+          ValueList base = Top().as_list();
           stack_.pop_back();
           base.push_back(std::move(item));
           Push(Value(std::move(base)));
@@ -295,7 +295,7 @@ class Decoder {
           size_t m = PopMark();
           ValueList items(stack_.begin() + m, stack_.end());
           stack_.resize(m);
-          ValueList base = stack_.back().as_list();
+          ValueList base = Top().as_list();
           stack_.pop_back();
           for (auto& it : items) base.push_back(std::move(it));
           Push(Value(std::move(base)));
@@ -304,7 +304,7 @@ class Decoder {
         case 'u': {  // SETITEMS
           size_t m = PopMark();
           ValueDict d = MakeDict(m);
-          ValueDict base = stack_.back().as_dict();
+          ValueDict base = Top().as_dict();
           stack_.pop_back();
           for (auto& [k, val] : d) base[k] = std::move(val);
           Push(Value(std::move(base)));
@@ -313,7 +313,7 @@ class Decoder {
         case 's': {  // SETITEM
           Value val = Pop();
           Value key = Pop();
-          ValueDict base = stack_.back().as_dict();
+          ValueDict base = Top().as_dict();
           stack_.pop_back();
           base[key.as_str()] = std::move(val);
           Push(Value(std::move(base)));
@@ -369,14 +369,25 @@ class Decoder {
     return s;
   }
   void Push(Value v) { stack_.push_back(std::move(v)); }
+  // All stack/mark accesses are underflow-checked so a truncated or
+  // corrupt frame raises std::runtime_error instead of hitting UB on an
+  // empty container (malformed input must fail loudly, not crash).
+  Value& Top() {
+    if (stack_.empty()) throw std::runtime_error("pickle: stack underflow");
+    return stack_.back();
+  }
   Value Pop() {
+    if (stack_.empty()) throw std::runtime_error("pickle: stack underflow");
     Value v = std::move(stack_.back());
     stack_.pop_back();
     return v;
   }
   size_t PopMark() {
+    if (marks_.empty()) throw std::runtime_error("pickle: mark underflow");
     size_t m = marks_.back();
     marks_.pop_back();
+    if (m > stack_.size())
+      throw std::runtime_error("pickle: mark beyond stack");
     return m;
   }
   ValueDict MakeDict(size_t from) {

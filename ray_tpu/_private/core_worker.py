@@ -26,7 +26,7 @@ import threading
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
@@ -183,8 +183,9 @@ class ObjectRef:
 
     def __del__(self):
         # lock-free: GC of a big ref container (10k+ refs) must not pay
-        # a lock round-trip per ref — appends batch and drain under ONE
-        # lock (list.append is GIL-atomic)
+        # a lock round-trip per ref — deque.append is GIL-atomic and the
+        # drain pops until empty, so an append racing a drain lands in
+        # the queue for the next sweep instead of being discarded
         w = _global_worker
         if w is not None:
             try:
@@ -380,14 +381,17 @@ class CoreWorker:
 
         # notified whenever any owned object completes: event-driven wait()
         self._ready_cv = threading.Condition()
+        # asyncio-side waiters parked in _rpc_wait_objects long-polls
+        # (one Event per in-flight wait; woken by _notify_ready)
+        self._ready_waiters: set = set()
 
         # batched borrower (de)registration: deserializing a container of
         # N refs costs O(1) flush RPCs per owner instead of N
         self._borrow_notify_lock = threading.Lock()
-        # GC'd refs awaiting batched unref (ObjectRef.__del__); the
-        # swap in _drain_unrefs must be atomic vs concurrent drains
-        self._pending_unrefs: List[ObjectID] = []
-        self._unref_swap_lock = threading.Lock()
+        # GC'd refs awaiting batched unref (ObjectRef.__del__): a deque
+        # drained by popleft-until-empty, so appends racing a drain are
+        # kept for the next sweep rather than lost with a swapped list
+        self._pending_unrefs: Deque[ObjectID] = collections.deque()
         self._borrow_add_batch: Dict[tuple, set] = {}
         self._borrow_remove_batch: Dict[tuple, set] = {}
         self._borrow_flush_scheduled = False
@@ -514,6 +518,7 @@ class CoreWorker:
     def _register_handlers(self):
         s = self._server
         s.register_method("get_object_info", self._rpc_get_object_info)
+        s.register_method("wait_objects", self._rpc_wait_objects)
         s.register_method("add_borrower", self._rpc_add_borrower)
         s.register_method("report_stream_items",
                           self._rpc_report_stream_items)
@@ -834,37 +839,94 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
+        # Borrowed refs (not in our records): ONE long-poll wait_objects
+        # RPC per owner feeds this set, instead of a 20 ms per-ref probe
+        # loop (reference: WaitManager + object-ready subscriptions).
+        borrow_ready: set = set()
+        subs: Dict[tuple, Any] = {}  # owner addr -> in-flight cf.Future
+        retry_at: Dict[tuple, float] = {}
+        first_pass = True
         while True:
             still = []
             for r in pending:
-                if self._is_ready(r):
+                if r.id.binary() in borrow_ready or self._is_ready(
+                        r, probe_owner=False):
                     ready.append(r)
                 else:
                     still.append(r)
             pending = still
             if len(ready) >= num_returns or not pending:
                 break
-            if deadline is not None and time.monotonic() >= deadline:
+            now = time.monotonic()
+            if deadline is not None and now >= deadline and not first_pass:
                 break
-            # Owned refs: sleep on the completion condvar (notified by
-            # _on_task_done & co) — event-driven, no poll tax (reference:
-            # WaitManager). Borrowed refs still need owner polling, so cap
-            # the sleep to keep their probe cadence.
-            any_borrowed = any(
-                r.id.binary() not in self._records for r in pending
-            )
-            step = 0.02 if any_borrowed else 0.5
+            # (re)arm one subscription per owner of pending borrowed refs
+            by_owner: Dict[tuple, List[bytes]] = {}
+            with self._records_lock:
+                for r in pending:
+                    if (r.owner_address is not None
+                            and tuple(r.owner_address) != self.address
+                            and r.id.binary() not in self._records):
+                        by_owner.setdefault(
+                            tuple(r.owner_address), []).append(r.id.binary())
+            for addr, oids in by_owner.items():
+                fut = subs.get(addr)
+                if fut is not None and fut.done() and fut.exception():
+                    if now < retry_at.get(addr, 0.0):
+                        continue  # owner unreachable: back off the respawn
+                    retry_at[addr] = now + 0.2
+                    fut = None
+                if fut is None or fut.done():
+                    subs[addr] = self._spawn_borrow_wait(
+                        addr, oids, borrow_ready)
+            if first_pass and deadline is not None and now >= deadline:
+                # zero-timeout wait: give the batched owner probes one
+                # short chance so semantics match the old per-ref probe
+                # (which blocked on sync RPCs anyway)
+                for fut in subs.values():
+                    try:
+                        fut.result(timeout=0.25)
+                    except Exception:
+                        pass
+                first_pass = False
+                continue
+            first_pass = False
+            step = 0.5
             if deadline is not None:
                 step = min(step, max(0.0, deadline - time.monotonic()))
             with self._ready_cv:
                 self._ready_cv.wait(step)
+        for fut in subs.values():
+            fut.cancel()
         return ready, pending
+
+    def _spawn_borrow_wait(self, addr: tuple, oids: List[bytes],
+                           borrow_ready: set):
+        """One long-poll to `addr` covering every pending borrowed ref it
+        owns; ready ids land in borrow_ready and wake the wait condvar."""
+
+        async def go():
+            cli = self._pool.get(*addr)
+            out = await cli.call("wait_objects", object_ids=list(oids),
+                                 timeout_s=5.0, timeout=10.0)
+            newly = out.get("ready") or ()
+            if newly:
+                borrow_ready.update(newly)
+                self._notify_ready()
+
+        return EventLoopThread.get().spawn(go())
 
     def _notify_ready(self):
         with self._ready_cv:
             self._ready_cv.notify_all()
+        if self._ready_waiters:
+            try:
+                EventLoopThread.get().loop.call_soon_threadsafe(
+                    self._wake_ready_waiters)
+            except RuntimeError:
+                pass  # loop shut down
 
-    def _is_ready(self, ref: ObjectRef) -> bool:
+    def _is_ready(self, ref: ObjectRef, probe_owner: bool = True) -> bool:
         if self.memory_store.contains(ref.id):
             return True
         with self._records_lock:
@@ -873,7 +935,7 @@ class CoreWorker:
             return rec.event.is_set()
         if self.store.contains(ref.id):
             return True
-        if ref.owner_address is None:
+        if ref.owner_address is None or not probe_owner:
             return False
         try:
             info = self._pool.get(*ref.owner_address).call_sync(
@@ -901,9 +963,15 @@ class CoreWorker:
 
     def _drain_unrefs(self):
         """Batched remove_local_ref for GC'd refs (see ObjectRef.__del__):
-        the whole batch processes under one records-lock acquisition."""
-        with self._unref_swap_lock:
-            batch, self._pending_unrefs = self._pending_unrefs, []
+        the whole batch processes under one records-lock acquisition.
+        Pops until empty — concurrent appends either join this batch or
+        stay queued for the next drain; none are dropped."""
+        batch: List[ObjectID] = []
+        try:
+            while True:
+                batch.append(self._pending_unrefs.popleft())
+        except IndexError:
+            pass
         if not batch:
             return
         mem_deletes: List[ObjectID] = []
@@ -1140,6 +1208,43 @@ class CoreWorker:
                 return {"pending": True}
             await asyncio.sleep(0.005)
 
+    async def _rpc_wait_objects(self, object_ids: List[bytes],
+                                timeout_s: float = 10.0):
+        """Owner service: long-poll until ANY of object_ids is ready.
+
+        Lets borrowers wait on owned objects event-driven — one RPC per
+        owner per wait instead of a 20 ms per-ref probe loop (reference:
+        wait_manager.cc subscribes waits to object-ready callbacks)."""
+        deadline = time.monotonic() + max(0.0, min(timeout_s, 30.0))
+        while True:
+            ready: List[bytes] = []
+            with self._records_lock:
+                for ob in object_ids:
+                    rec = self._records.get(ob)
+                    if rec is None or rec.event.is_set():
+                        # unknown ids are 'ready': the follow-up get
+                        # surfaces inline value or ObjectLostError
+                        ready.append(ob)
+            if ready or time.monotonic() >= deadline:
+                return {"ready": ready}
+            await self._await_ready_signal(deadline)
+
+    async def _await_ready_signal(self, deadline: float):
+        """Park until _notify_ready fires (or a short backstop lapses)."""
+        ev = asyncio.Event()
+        self._ready_waiters.add(ev)
+        try:
+            step = max(0.01, min(0.25, deadline - time.monotonic()))
+            await asyncio.wait_for(ev.wait(), timeout=step)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._ready_waiters.discard(ev)
+
+    def _wake_ready_waiters(self):
+        for ev in list(self._ready_waiters):
+            ev.set()
+
     # ==================================================================
     # normal task submission (reference: normal_task_submitter.cc)
     # ==================================================================
@@ -1357,17 +1462,22 @@ class CoreWorker:
     def _on_task_failed(self, spec: dict, error: Exception) -> bool:
         """Returns True if the task will be retried."""
         task_id = spec["task_id"]
+        was_streaming = False
         with self._records_lock:
             done = self._tasks.get(task_id)
             if done is not None and done.status == "FINISHED":
                 return False  # result already streamed before the failure
             if done is not None and done.stream is not None:
+                was_streaming = True
                 done.stream["error"] = serialization.dumps(
                     RayTaskError(f"streaming task failed: {error}",
                                  type(error).__name__))
                 done.status = "FAILED"
                 retained, done.retained = done.retained, []
-        if done is not None and done.stream is not None:
+        # branch on the flag captured under the lock: done.stream may be
+        # nulled by ObjectRefGenerator.__del__ on another thread, and the
+        # locally-swapped `retained` refs must still be released
+        if was_streaming:
             for oid in retained:
                 self._release_ref(oid)
             self._notify_ready()
@@ -1814,6 +1924,25 @@ class CoreWorker:
                                        node_id: str):
         """Owner service: install streamed generator items as owned
         objects as they arrive."""
+        # First pass under the lock: find which items are genuinely new
+        # (dead stream / duplicate retries decode nothing — user
+        # __setstate__ side effects must not run twice).
+        with self._records_lock:
+            task = self._tasks.get(task_id)
+            if task is None or task.stream is None:
+                return True
+            fresh = {oid_bytes for _idx, (oid_bytes, _k, _p) in items
+                     if oid_bytes not in self._records}
+        if not fresh:
+            return True
+        # Deserialize inline payloads BETWEEN lock acquisitions: loads()
+        # runs arbitrary user __setstate__ and re-enters borrow
+        # registration, neither of which may run under the owner's
+        # global records lock.
+        decoded: Dict[bytes, Any] = {}
+        for idx, (oid_bytes, kind, payload) in items:
+            if kind == "inline" and oid_bytes in fresh:
+                decoded[oid_bytes] = serialization.loads(payload)
         with self._records_lock:
             task = self._tasks.get(task_id)
             if task is None or task.stream is None:
@@ -1821,7 +1950,7 @@ class CoreWorker:
             stream = task.stream
             arrived = stream.setdefault("arrived", set())
             for idx, (oid_bytes, kind, payload) in items:
-                if oid_bytes in self._records:
+                if oid_bytes in self._records or oid_bytes not in fresh:
                     continue  # duplicate delivery
                 rec = _ObjectRecord()
                 rec.pending = False
@@ -1830,7 +1959,7 @@ class CoreWorker:
                 rec.local_refs = 1
                 if kind == "inline":
                     self.memory_store.put(ObjectID(oid_bytes),
-                                          serialization.loads(payload))
+                                          decoded[oid_bytes])
                 elif kind == "shm":
                     rec.size = payload["size"]
                     rec.locations.add(node_id)

@@ -31,9 +31,39 @@ def _set_model_id(model_id: str):
     _current_model_id.set(model_id or "")
 
 
+async def _teardown_model(model: Any) -> None:
+    """Run the evicted model's teardown hook, if it has one.
+
+    Teardown is eager, matching the reference (serve/_private/multiplex.py
+    unloads the LRU model at eviction time): a request still mid-inference
+    on an evicted model races its teardown, so size max_num_models_per_replica
+    above the number of concurrently-active distinct models. Hook errors
+    are swallowed: eviction must never fail the load that triggered it.
+    Sync hooks run in the default executor so a slow close() can't stall
+    the replica's event loop."""
+    for name in ("__serve_teardown__", "aclose", "close"):
+        hook = getattr(model, name, None)
+        if hook is None or not callable(hook):
+            continue
+        try:
+            if inspect.iscoroutinefunction(hook):
+                await hook()
+            else:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    None, hook)
+                if inspect.isawaitable(result):
+                    await result
+        except Exception:
+            pass
+        return
+
+
 class _ModelCache:
-    """Per-replica LRU of loaded models; eviction calls __del__ (and
-    async teardown hooks are awaited when present)."""
+    """Per-replica LRU of loaded models. Eviction awaits the evicted
+    model's teardown hook — ``__serve_teardown__``, ``aclose`` or
+    ``close``, whichever exists first (async hooks are awaited, sync
+    ones run in the default executor) — then drops the cache reference
+    so ``__del__`` can fire if nothing else holds the model."""
 
     def __init__(self, loader: Callable, max_models: int):
         self.loader = loader
@@ -72,13 +102,17 @@ class _ModelCache:
                 self._loading.pop(model_id, None)
             fut.set_exception(e)
             raise
+        evicted = []
         async with self._lock:
             while len(self.models) >= self.max_models:
-                _old_id, old = self.models.popitem(last=False)
-                del old
+                evicted.append(self.models.popitem(last=False))
             self.models[model_id] = model
             self._loading.pop(model_id, None)
         fut.set_result(model)
+        # teardown outside the lock: a slow hook (freeing device memory)
+        # must not block cache hits for other models
+        for _old_id, old in evicted:
+            await _teardown_model(old)
         return model
 
     def loaded_ids(self):

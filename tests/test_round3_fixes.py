@@ -1,0 +1,289 @@
+"""Regression tests for round-3 advisor findings (ADVICE.md round 2).
+
+Covers: the lost-unref race between ObjectRef.__del__ and _drain_unrefs,
+the _on_task_failed stream re-read outside the records lock, stream-item
+deserialization running under the owner's records lock, C++ pickle
+decoder underflow on corrupt frames, and multiplex eviction teardown.
+"""
+import asyncio
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+import ray_tpu as ray
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 4})
+    yield
+    ray.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 1) GC unrefs racing the drain must never be dropped (ADVICE r2 #1):
+#    the swap-based drain could discard an append that landed between the
+#    list swap and the iteration; the deque drain keeps it queued.
+# ---------------------------------------------------------------------------
+def test_gc_unref_survives_concurrent_drain(ray_start):
+    import ray_tpu.api as api
+
+    w = api.global_worker()
+    n_threads, per_thread = 4, 500
+    keys = []
+
+    def churn(tid):
+        for i in range(per_thread):
+            ref = ray.put(("unref-race", tid, i))
+            keys.append(ref.id.binary())
+            del ref  # __del__ appends to the pending-unref queue
+            if i % 7 == 0:
+                w._drain_unrefs()  # race drains against appends
+
+    threads = [threading.Thread(target=churn, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # final drains: anything still queued must release now
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        w._drain_unrefs()
+        with w._records_lock:
+            leaked = [k for k in keys if k in w._records]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"{len(leaked)} unrefs lost to the drain race"
+
+
+# ---------------------------------------------------------------------------
+# 2) _on_task_failed must release retained arg refs even if another
+#    thread nulls task.stream between the locked block and the branch
+#    (ADVICE r2 #2: branch on a flag captured under the lock).
+# ---------------------------------------------------------------------------
+def test_streaming_failure_releases_retained_despite_stream_null(ray_start):
+    import ray_tpu.api as api
+    from ray_tpu._private.core_worker import _TaskRecord
+
+    w = api.global_worker()
+    pinned = ray.put("pinned-arg")
+    with w._records_lock:
+        w._records[pinned.id.binary()].local_refs += 1  # retained pin
+        before = w._records[pinned.id.binary()].local_refs
+
+    task_id = b"round3-streaming-fail-task"
+    rec = _TaskRecord({"task_id": task_id, "name": "gen"}, 0, [],
+                      retained=[pinned.id])
+    rec.stream = {"count": 0, "total": None, "error": None}
+    with w._records_lock:
+        w._tasks[task_id] = rec
+
+    # Wrap the records lock so that the FIRST release (the end of the
+    # locked block that swaps `retained`) nulls task.stream — simulating
+    # ObjectRefGenerator.__del__ on another thread.
+    real_lock = w._records_lock
+
+    class _StreamNullingLock:
+        def __init__(self):
+            self.fired = False
+
+        def __enter__(self):
+            return real_lock.__enter__()
+
+        def __exit__(self, *exc):
+            out = real_lock.__exit__(*exc)
+            if not self.fired:
+                self.fired = True
+                rec.stream = None
+            return out
+
+        def __getattr__(self, name):  # acquire/release passthrough
+            return getattr(real_lock, name)
+
+    w._records_lock = _StreamNullingLock()
+    try:
+        retried = w._on_task_failed(rec.spec, RuntimeError("boom"))
+    finally:
+        w._records_lock = real_lock
+    assert retried is False
+    w._drain_unrefs()
+    with w._records_lock:
+        after = w._records[pinned.id.binary()].local_refs
+    assert after == before - 1, (
+        "retained arg ref leaked when stream was nulled concurrently")
+    with w._records_lock:
+        w._tasks.pop(task_id, None)
+
+
+# ---------------------------------------------------------------------------
+# 3) Stream-item payloads deserialize OUTSIDE the records lock
+#    (ADVICE r2 #3: loads() runs user __setstate__ / borrow re-entry).
+# ---------------------------------------------------------------------------
+def test_stream_items_deserialized_outside_records_lock(ray_start):
+    import ray_tpu.api as api
+    from ray_tpu._private import serialization
+    from ray_tpu._private.core_worker import _TaskRecord
+
+    w = api.global_worker()
+    task_id = b"round3-stream-lock-task"
+    rec = _TaskRecord({"task_id": task_id, "name": "gen"}, 0, [])
+    rec.stream = {"count": 0, "total": None, "error": None}
+    with w._records_lock:
+        w._tasks[task_id] = rec
+
+    held_during_loads = []
+    real_loads = serialization.loads
+
+    def probing_loads(payload):
+        # RLock is reentrant for the holder, so probe from a helper
+        # thread: if acquire fails there, THIS thread holds the lock.
+        got = []
+
+        def probe():
+            ok = w._records_lock.acquire(timeout=0.0)
+            if ok:
+                w._records_lock.release()
+            got.append(ok)
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        held_during_loads.append(not got[0])
+        return real_loads(payload)
+
+    payload = serialization.dumps({"item": 0})
+    items = [(0, (b"round3-stream-item00", "inline",
+                  payload))]
+    serialization.loads = probing_loads
+    try:
+        asyncio.run(w._rpc_report_stream_items(task_id, items, w.node_id))
+    finally:
+        serialization.loads = real_loads
+        with w._records_lock:
+            w._tasks.pop(task_id, None)
+            w._records.pop(b"round3-stream-item00", None)
+    assert held_during_loads == [False], (
+        "stream-item payload deserialized while holding _records_lock")
+
+
+# ---------------------------------------------------------------------------
+# 4) C++ pickle decoder: truncated / corrupt frames raise runtime_error
+#    instead of invoking UB on empty value/mark stacks (ADVICE r2 #4).
+# ---------------------------------------------------------------------------
+CORRUPT_FRAME_CC = r"""
+#include <cstdio>
+#include <stdexcept>
+#include <string>
+#include "ray_tpu/pickle.h"
+using ray_tpu::pickle::Decode;
+
+static int expect_throw(const std::string& name, const std::string& frame) {
+  try {
+    Decode(frame);
+  } catch (const std::runtime_error&) {
+    return 0;  // failed loudly, as required
+  } catch (...) {
+    std::printf("FAIL %s: wrong exception type\n", name.c_str());
+    return 1;
+  }
+  std::printf("FAIL %s: no exception\n", name.c_str());
+  return 1;
+}
+
+int main() {
+  int rc = 0;
+  // Value-stack underflow: ops that pop from an empty stack.
+  rc |= expect_throw("stop-empty", std::string("."));
+  rc |= expect_throw("memoize-empty", std::string("\x94", 1));
+  rc |= expect_throw("append-empty", std::string("a"));
+  rc |= expect_throw("setitem-empty", std::string("s"));
+  rc |= expect_throw("tuple1-empty", std::string("\x85", 1));
+  rc |= expect_throw("tuple3-one", std::string("N\x87", 2));
+  rc |= expect_throw("binput-empty", std::string("q\x00", 2));
+  // Mark-stack underflow: APPENDS/SETITEMS/TUPLE with no MARK.
+  rc |= expect_throw("appends-nomark", std::string("]e"));
+  rc |= expect_throw("setitems-nomark", std::string("}u"));
+  rc |= expect_throw("tuple-nomark", std::string("t"));
+  // APPENDS where the mark consumed the would-be list base.
+  rc |= expect_throw("appends-nobase", std::string("(e"));
+  // Truncated length-prefixed reads.
+  rc |= expect_throw("trunc-binunicode", std::string("X\xff\x00\x00\x00hi",
+                                                     7));
+  rc |= expect_throw("trunc-frame", std::string("\x80\x02", 2));
+  if (rc == 0) std::printf("PICKLE_FUZZ_OK\n");
+  return rc;
+}
+"""
+
+
+def test_pickle_decoder_rejects_corrupt_frames(tmp_path):
+    src = tmp_path / "pickle_fuzz.cc"
+    src.write_text(CORRUPT_FRAME_CC)
+    out = str(tmp_path / "pickle_fuzz")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-fsanitize=address,undefined",
+         "-I", os.path.join(REPO, "cpp/include"), str(src), "-o", out],
+        check=True, capture_output=True, text=True,
+    )
+    proc = subprocess.run([out], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "PICKLE_FUZZ_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# 5) Multiplex eviction awaits the evicted model's teardown hook
+#    (ADVICE r2 #5: the docstring promised teardown that never ran).
+# ---------------------------------------------------------------------------
+def test_multiplex_eviction_awaits_teardown():
+    from ray_tpu.serve.multiplex import _ModelCache
+
+    torn_down = []
+
+    class Model:
+        def __init__(self, model_id):
+            self.model_id = model_id
+
+        async def __serve_teardown__(self):
+            await asyncio.sleep(0)  # prove the hook is awaited, not just called
+            torn_down.append(self.model_id)
+
+    async def main():
+        cache = _ModelCache(lambda owner, mid: Model(mid), max_models=2)
+        await cache.get(None, "a")
+        await cache.get(None, "b")
+        await cache.get(None, "c")  # evicts "a"
+        assert cache.loaded_ids() == ["b", "c"]
+        await cache.get(None, "b")  # refresh LRU order
+        await cache.get(None, "d")  # evicts "c"
+        assert cache.loaded_ids() == ["b", "d"]
+
+    asyncio.run(main())
+    assert torn_down == ["a", "c"]
+
+
+def test_multiplex_sync_close_hook_runs():
+    from ray_tpu.serve.multiplex import _ModelCache
+
+    closed = []
+
+    class Model:
+        def __init__(self, model_id):
+            self.model_id = model_id
+
+        def close(self):
+            closed.append(self.model_id)
+
+    async def main():
+        cache = _ModelCache(lambda owner, mid: Model(mid), max_models=1)
+        await cache.get(None, "x")
+        await cache.get(None, "y")
+
+    asyncio.run(main())
+    assert closed == ["x"]
