@@ -82,6 +82,13 @@ class Config:
     # lineage reconstruction
     enable_lineage_reconstruction: bool = True
     max_lineage_bytes: int = 256 * 1024 * 1024
+    # How long an out-of-band serialized ref pins its object while no
+    # live handle or registered borrower holds it (reference does
+    # synchronous borrow confirmation, reference_count.h:73; we pin at
+    # serialization and let the borrower's registration consume the pin
+    # — this TTL only bounds pins whose bytes are never deserialized).
+    # After expiry a late deserializer gets a clean ObjectLostError.
+    borrow_pin_ttl_s: float = 60.0
 
     # --- RPC / protocol ---
     rpc_connect_timeout_s: float = 10.0

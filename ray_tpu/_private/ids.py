@@ -11,6 +11,38 @@ owner-based object management and lineage reconstruction.
 from __future__ import annotations
 
 import os
+import threading
+
+
+class _EntropyPool(threading.local):
+    """Buffered os.urandom: one 64 KiB syscall serves ~5k IDs.
+
+    Thread-local so two threads can never hand out the same slice;
+    pid-checked so a fork()ed child never replays the parent's buffer
+    (duplicate IDs across processes would corrupt ownership)."""
+
+    def __init__(self):
+        self.buf = b""
+        self.off = 0
+        self.pid = os.getpid()
+
+
+_entropy = _EntropyPool()
+
+
+def _rand_bytes(n: int) -> bytes:
+    if n > 65536:
+        return os.urandom(n)  # larger than the refill buffer
+    p = _entropy
+    if p.pid != os.getpid():
+        p.buf, p.off, p.pid = b"", 0, os.getpid()
+    end = p.off + n
+    if end > len(p.buf):
+        p.buf = os.urandom(65536)
+        p.off, end = 0, n
+    out = p.buf[p.off:end]
+    p.off = end
+    return out
 
 
 _JOB_ID_LEN = 4
@@ -34,7 +66,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.LENGTH))
+        return cls(_rand_bytes(cls.LENGTH))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -93,7 +125,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID):
-        return cls(job_id.binary() + os.urandom(cls.LENGTH - _JOB_ID_LEN))
+        return cls(job_id.binary() + _rand_bytes(cls.LENGTH - _JOB_ID_LEN))
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[:_JOB_ID_LEN])
@@ -104,7 +136,7 @@ class TaskID(BaseID):
 
     @classmethod
     def for_job(cls, job_id: JobID):
-        return cls(job_id.binary() + os.urandom(_UNIQUE_LEN))
+        return cls(job_id.binary() + _rand_bytes(_UNIQUE_LEN))
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[:_JOB_ID_LEN])
@@ -138,6 +170,6 @@ class PlacementGroupID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID):
-        return cls(job_id.binary() + os.urandom(cls.LENGTH - _JOB_ID_LEN))
+        return cls(job_id.binary() + _rand_bytes(cls.LENGTH - _JOB_ID_LEN))
 
 

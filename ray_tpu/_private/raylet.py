@@ -168,6 +168,9 @@ class Raylet:
         self._pool = ClientPool()
         self.session_dir = session_dir
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        from .runtime_env import RuntimeEnvManager
+
+        self._runtime_envs = RuntimeEnvManager(session_dir)
         cfg = get_config()
         self._cfg = cfg
 
@@ -225,6 +228,12 @@ class Raylet:
         self._chip_grant_lock = asyncio.Lock()
         # recently-seen infeasible shapes (shape-tuple -> last ts)
         self._infeasible_demand: Dict[tuple, float] = {}
+        # (shape, submitter pool id) -> (backlog, last-seen ts): lease
+        # requests carry the submitter's queue depth so the autoscaler
+        # sees the REAL demand even though submitters pipeline only a
+        # few in-flight lease requests at a time (reference:
+        # backlog_size on RequestWorkerLease feeding the resource report)
+        self._backlog_demand: Dict[tuple, tuple] = {}
 
         # per-worker metric snapshots (reference: metrics_agent.py —
         # every process exports to the node agent; here the raylet IS
@@ -347,6 +356,21 @@ class Raylet:
                 del self._infeasible_demand[shape]
             else:
                 out.append(dict(shape))
+        # submitter backlogs: one shape copy per queued-but-unrequested
+        # task, summed across submitter pools (capped — the autoscaler
+        # sizes incrementally anyway)
+        # longer cutoff than waiters: a pool whose lease requests are all
+        # in flight (saturated cluster) sends no refresh until a grant
+        # frees a slot, which can take far longer than 5 s
+        backlog_cutoff = time.time() - 30.0
+        per_shape: Dict[tuple, int] = {}
+        for (shape, _pool), (n, ts) in list(self._backlog_demand.items()):
+            if ts < backlog_cutoff:
+                del self._backlog_demand[(shape, _pool)]
+            else:
+                per_shape[shape] = per_shape.get(shape, 0) + n
+        for shape, n in per_shape.items():
+            out.extend(dict(shape) for _ in range(min(n, 100)))
         return out
 
     def _idle_duration_s(self) -> float:
@@ -510,6 +534,7 @@ class Raylet:
         # runtime env applied at spawn (reference: runtime_env_agent
         # prepares the env before the worker starts, runtime_env_agent.py:165)
         cwd = None
+        py_exe = sys.executable
         if runtime_env:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 env[k] = str(v)
@@ -517,9 +542,16 @@ class Raylet:
             if wd:
                 cwd = wd
                 env["PYTHONPATH"] = wd + os.pathsep + env["PYTHONPATH"]
+            # pip venv interpreter + py_modules path (materialized by
+            # _grant_lease via RuntimeEnvManager.ensure before spawn)
+            st = self._runtime_envs.lookup(runtime_env)
+            if st.python:
+                py_exe = st.python
+            for p in st.pythonpath:
+                env["PYTHONPATH"] = p + os.pathsep + env["PYTHONPATH"]
         proc = subprocess.Popen(
             [
-                sys.executable,
+                py_exe,
                 "-m",
                 "ray_tpu._private.worker_main",
                 "--raylet-host", self.address[0],
@@ -688,6 +720,8 @@ class Raylet:
         bundle_index: int = -1,
         allow_spill: bool = True,
         wait: bool = True,
+        backlog: int = 0,
+        backlog_id: str = "",
     ):
         """Grant a leased worker, queue until resources free, or spill.
 
@@ -736,13 +770,30 @@ class Raylet:
                 ] = time.time()
             return {"ok": False, "spill_to": spill, "infeasible": spill is None}
 
+        if pg_key is None and backlog_id and backlog > 0:
+            # record this submitter pool's queued backlog for the
+            # autoscaler demand report. Keyed per submitter pool so one
+            # pool draining can't erase another's demand; cleared on
+            # lease return with an empty queue (return_worker) or by
+            # the report cutoff. Recorded only past the never-fits
+            # branch — a spilled request records at the spill target,
+            # not twice.
+            self._backlog_demand[
+                (tuple(sorted(demand.items())), backlog_id)
+            ] = (int(backlog), time.time())
+
         ok, resolved_key = self._try_acquire(demand, pg_key)
+        t_queue = time.monotonic()
         if not ok:
             if not wait:
                 return {"ok": False, "spill_to": None, "infeasible": False}
             if pg_key is None and allow_spill:
                 spill = self._pick_spill_node(demand, require_available=True)
                 if spill is not None and spill[0] != self.node_id:
+                    # the spill target will serve (and record) this
+                    # pool's demand
+                    self._backlog_demand.pop(
+                        (tuple(sorted(demand.items())), backlog_id), None)
                     return {"ok": False, "spill_to": spill, "infeasible": False}
             # Queue until resources are released.
             fut = asyncio.get_running_loop().create_future()
@@ -752,8 +803,17 @@ class Raylet:
             if granted is False:
                 return {"ok": False, "spill_to": None, "infeasible": False}
             resolved_key = granted  # the grant loop acquired + resolved
-        return await self._grant_lease(demand, resolved_key, lease_type,
+        # how long this request sat waiting for RESOURCES — snapshotted
+        # BEFORE _grant_lease so cold worker spawn/registration never
+        # counts: holders use this as the contention signal for their
+        # idle-lease linger, and a cold spawn on an idle cluster must
+        # not read as contention
+        queued_s = time.monotonic() - t_queue
+        reply = await self._grant_lease(demand, resolved_key, lease_type,
                                        runtime_env)
+        if isinstance(reply, dict) and reply.get("ok"):
+            reply["queued_s"] = queued_s
+        return reply
 
     async def _grant_lease(self, demand, pg_key, lease_type,
                            runtime_env: Optional[dict] = None):
@@ -764,6 +824,18 @@ class Raylet:
             if (k == "TPU" or k.startswith("TPU-")) and v > 0:
                 tpu_chips = max(tpu_chips, int(v))
         env_key = self._runtime_env_key(runtime_env)
+        from .runtime_env import needs_materialization
+
+        if needs_materialization(runtime_env):
+            # pip venv / py_modules build once per env key; concurrent
+            # grants await the same build (reference: runtime_env_agent
+            # GetOrCreateRuntimeEnv before worker lease fulfillment)
+            try:
+                await self._runtime_envs.ensure(runtime_env)
+            except Exception as e:
+                self._release_after_grant(demand, pg_key)
+                return {"ok": False, "spill_to": None,
+                        "infeasible": False, "fatal": str(e)}
         if tpu_chips > 0:
             # chip grants serialize: eviction awaits process exit, and a
             # concurrent grant running between "victims removed from
@@ -854,8 +926,24 @@ class Raylet:
             return None
         return (nid, list(self._view[nid].address))
 
+    async def clear_backlog(self, backlog_id: str):
+        """A submitter pool's queue drained (without necessarily
+        returning leases — the linger may hold them): drop its recorded
+        autoscaler backlog immediately."""
+        for key in list(self._backlog_demand):
+            if key[1] == backlog_id:
+                del self._backlog_demand[key]
+        return True
+
     async def return_worker(self, worker_id: str = "", lease_id: str = "",
-                            ok: bool = True):
+                            ok: bool = True, backlog_id: str = ""):
+        if backlog_id:
+            # the holder's queue is drained (leases only come back on
+            # drain): actively clear its recorded backlog instead of
+            # waiting out the report cutoff
+            for key in list(self._backlog_demand):
+                if key[1] == backlog_id:
+                    del self._backlog_demand[key]
         lease = None
         if lease_id:
             lease = self._leases.pop(lease_id, None)

@@ -1,0 +1,229 @@
+"""Runtime-environment materialization: pip venvs + py_modules.
+
+The reference runs a per-node runtime-env agent that materializes
+environments before a worker starts (reference:
+python/ray/_private/runtime_env/agent/runtime_env_agent.py:165,
+runtime_env/pip.py, runtime_env/py_modules.py). Here the raylet owns the
+same job directly:
+
+- ``pip``: a per-env virtualenv (``--system-site-packages`` so the node
+  image's jax/numpy stay visible) created once under the session dir and
+  shared by every worker keyed to that env. Workers spawn with the
+  venv's interpreter.
+- ``py_modules``: local directories are copied (and wheels installed via
+  ``pip install --target``) into a per-env directory that is prepended
+  to the worker's ``PYTHONPATH``.
+
+Creation is serialized per env key, logged to the session dir, cached on
+disk (a ``.ready`` marker), and failures surface to the lease caller as
+a fatal grant error with the installer's output tail.
+
+Supported pip forms (mirrors the reference's schema):
+    {"pip": ["pkg==1.0", "/path/to/local.whl"]}
+    {"pip": {"packages": [...], "pip_check": False}}
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _pip_packages(runtime_env: dict) -> List[str]:
+    pip = runtime_env.get("pip")
+    if not pip:
+        return []
+    if isinstance(pip, dict):
+        return list(pip.get("packages") or [])
+    if isinstance(pip, str):
+        # requirements-file path (reference accepts it too)
+        with open(pip) as f:
+            return [
+                ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")
+            ]
+    return list(pip)
+
+
+def needs_materialization(runtime_env: Optional[dict]) -> bool:
+    return bool(runtime_env) and bool(
+        runtime_env.get("pip") or runtime_env.get("py_modules")
+    )
+
+
+class _EnvState:
+    __slots__ = ("python", "pythonpath", "error")
+
+    def __init__(self, python=None, pythonpath=(), error=None):
+        self.python = python          # interpreter for spawned workers
+        self.pythonpath = pythonpath  # extra PYTHONPATH entries
+        self.error = error
+
+
+class RuntimeEnvManager:
+    """Materializes pip/py_modules envs under ``<session_dir>/runtime_envs``.
+
+    ``ensure()`` is awaited on the raylet loop before a worker spawn;
+    ``lookup()`` is consulted synchronously inside the spawn."""
+
+    def __init__(self, session_dir: str):
+        self.root = os.path.join(session_dir, "runtime_envs")
+        os.makedirs(self.root, exist_ok=True)
+        self._states: Dict[str, _EnvState] = {}
+        self._locks: Dict[str, asyncio.Lock] = {}
+
+    @staticmethod
+    def env_hash(runtime_env: dict) -> str:
+        payload = {
+            "pip": _pip_packages(runtime_env),
+            "py_modules": list(runtime_env.get("py_modules") or []),
+        }
+        return hashlib.sha1(
+            json.dumps(payload, sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+    def lookup(self, runtime_env: Optional[dict]) -> _EnvState:
+        if not needs_materialization(runtime_env):
+            return _EnvState()
+        return self._states.get(self.env_hash(runtime_env), _EnvState())
+
+    async def ensure(self, runtime_env: dict) -> _EnvState:
+        """Materialize (once) and return the env state; raises
+        RuntimeError with the installer log tail on failure."""
+        key = self.env_hash(runtime_env)
+        lock = self._locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            st = self._states.get(key)
+            if st is not None:
+                return st
+            loop = asyncio.get_running_loop()
+            # failures are NOT cached: _materialize cleans its dir, so a
+            # transient failure (flaky index, racing disk pressure) heals
+            # on the next lease attempt instead of poisoning the env key
+            # for the node's lifetime
+            st = await loop.run_in_executor(
+                None, self._materialize, key, runtime_env
+            )
+            self._states[key] = st
+            return st
+
+    # -- blocking worker (thread pool) ---------------------------------
+    def _materialize(self, key: str, runtime_env: dict) -> _EnvState:
+        envdir = os.path.join(self.root, key)
+        marker = os.path.join(envdir, ".ready")
+        logpath = os.path.join(envdir, "setup.log")
+        venv_py = os.path.join(envdir, "venv", "bin", "python")
+        moddir = os.path.join(envdir, "py_modules")
+        if os.path.exists(marker):
+            # another raylet (or a previous incarnation) built it
+            return _EnvState(
+                python=venv_py if os.path.exists(venv_py) else None,
+                pythonpath=(moddir,) if os.path.isdir(moddir) else (),
+            )
+        os.makedirs(envdir, exist_ok=True)
+        log = open(logpath, "ab")
+        try:
+            python, pythonpath = None, []
+            pkgs = _pip_packages(runtime_env)
+            if pkgs:
+                python = self._build_venv(envdir, pkgs, log)
+            mods = list(runtime_env.get("py_modules") or [])
+            if mods:
+                pythonpath.append(
+                    self._build_py_modules(envdir, mods, python, log))
+            with open(marker, "w") as f:
+                f.write("ok")
+            return _EnvState(python=python, pythonpath=tuple(pythonpath))
+        except Exception:
+            log.flush()
+            tail = ""
+            try:
+                with open(logpath, "rb") as f:
+                    tail = f.read()[-2000:].decode(errors="replace")
+            except OSError:
+                pass
+            shutil.rmtree(envdir, ignore_errors=True)
+            raise RuntimeError(
+                f"runtime_env materialization failed "
+                f"(log: {logpath}):\n{tail}"
+            ) from None
+        finally:
+            log.close()
+
+    def _run(self, cmd: List[str], log) -> None:
+        log.write((" ".join(cmd) + "\n").encode())
+        log.flush()
+        res = subprocess.run(
+            cmd, stdout=log, stderr=subprocess.STDOUT, timeout=600
+        )
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"command failed (exit {res.returncode}): {' '.join(cmd)}"
+            )
+
+    def _build_venv(self, envdir: str, pkgs: List[str], log) -> str:
+        vdir = os.path.join(envdir, "venv")
+        self._run(
+            [sys.executable, "-m", "venv", "--system-site-packages", vdir],
+            log,
+        )
+        py = os.path.join(vdir, "bin", "python")
+        # When the raylet itself runs inside a venv, --system-site-
+        # packages links to that venv's BASE interpreter, not to the
+        # venv's site-packages — the node image's jax/numpy would
+        # vanish. A .pth in the new venv's site-packages restores them,
+        # appended AFTER its own site dir so pip-installed packages
+        # still shadow the parent's (the reference's pip env inherits
+        # the parent site the same way, runtime_env/pip.py).
+        import site
+
+        parent_sites = [p for p in site.getsitepackages()
+                        if os.path.isdir(p)]
+        probe = subprocess.run(
+            [py, "-c",
+             "import sysconfig; print(sysconfig.get_paths()['purelib'])"],
+            capture_output=True, text=True, timeout=60,
+        )
+        target = probe.stdout.strip()
+        if probe.returncode != 0 or not target.startswith(vdir):
+            # never fall back to the HOST interpreter's site-packages —
+            # writing the .pth there would mutate every future process
+            # of this interpreter
+            raise RuntimeError(
+                f"venv interpreter probe failed (exit {probe.returncode}): "
+                f"{probe.stderr.strip()[:500]}")
+        with open(os.path.join(target, "_parent_site.pth"), "w") as f:
+            f.write("\n".join(parent_sites) + "\n")
+        # --no-build-isolation would need network for build deps; local
+        # wheels and cached indexes both work through plain install.
+        self._run([py, "-m", "pip", "install", "--no-input", *pkgs], log)
+        return py
+
+    def _build_py_modules(
+        self, envdir: str, mods: List[str], python: Optional[str], log
+    ) -> str:
+        moddir = os.path.join(envdir, "py_modules")
+        os.makedirs(moddir, exist_ok=True)
+        for m in mods:
+            if m.endswith(".whl"):
+                self._run(
+                    [python or sys.executable, "-m", "pip", "install",
+                     "--no-input", "--no-index", "--no-deps",
+                     "--target", moddir, m],
+                    log,
+                )
+            elif os.path.isdir(m):
+                dest = os.path.join(moddir, os.path.basename(m.rstrip("/")))
+                if not os.path.exists(dest):
+                    shutil.copytree(m, dest)
+            else:
+                raise RuntimeError(
+                    f"py_modules entry {m!r} is neither a directory "
+                    "nor a wheel"
+                )
+        return moddir

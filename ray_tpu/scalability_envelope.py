@@ -1,0 +1,136 @@
+"""Scalability-envelope probes (`python -m ray_tpu.scalability_envelope`).
+
+Mirrors the reference's single-node scalability envelope
+(reference: release/benchmarks/README.md:27-31 and
+release/benchmarks/single_node/test_single_node.py): many task args,
+many task returns, many-object ray.get, a deep task queue, and a
+maximum-size object get. Reference numbers (v2.9.3, 1x m4.16xlarge,
+release/release_logs/2.9.3/scalability/single_node.json):
+
+    10,000 object args to a single task   17.30 s
+    3,000 returns from a single task       7.03 s
+    ray.get on 10,000 objects             26.53 s
+    queue 1,000,000 tasks                193.74 s
+    ray.get on a 100 GiB object           30.74 s
+
+Counts scale down via env vars for small hosts; the JSON records the
+counts actually used so ratios stay honest. The large-object probe is
+capped by free /dev/shm (the reference machine had 256 GiB RAM).
+Writes BENCH_envelope.json.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List
+
+import numpy as np
+
+import ray_tpu as ray
+
+NUM_ARGS = int(os.environ.get("RAY_TPU_ENVELOPE_ARGS", "10000"))
+NUM_RETURNS = int(os.environ.get("RAY_TPU_ENVELOPE_RETURNS", "3000"))
+NUM_GET = int(os.environ.get("RAY_TPU_ENVELOPE_GET", "10000"))
+NUM_QUEUED = int(os.environ.get("RAY_TPU_ENVELOPE_QUEUED", "1000000"))
+LARGE_GIB_CAP = float(os.environ.get("RAY_TPU_ENVELOPE_LARGE_GIB", "8"))
+
+REFERENCE = {
+    "many task args": {"count": 10000, "seconds": 17.30},
+    "many task returns": {"count": 3000, "seconds": 7.03},
+    "ray.get many objects": {"count": 10000, "seconds": 26.53},
+    "queue many tasks": {"count": 1000000, "seconds": 193.74},
+    "large object get": {"gib": 100.0, "seconds": 30.74},
+}
+
+
+@ray.remote
+def nop(*args):
+    return None
+
+
+@ray.remote
+def nop_returns(n):
+    return tuple(range(n))
+
+
+def probe(name: str, fn, results: List[dict], **extra):
+    t0 = time.perf_counter()
+    fn()
+    dt = time.perf_counter() - t0
+    ref = REFERENCE[name]
+    row = {"name": name, "seconds": round(dt, 2), "reference": ref, **extra}
+    print(f"{name}: {dt:.2f} s  (ref {ref['seconds']} s "
+          f"@ {ref.get('count', ref.get('gib'))})", flush=True)
+    results.append(row)
+
+
+def main() -> List[dict]:
+    results: List[dict] = []
+    from ray_tpu.microbenchmark import bench_init
+
+    bench_init()
+    try:
+        # warm the worker pool
+        ray.get([nop.remote() for _ in range(20)])
+
+        refs = [ray.put(0) for _ in range(NUM_ARGS)]
+        probe("many task args",
+              lambda: ray.get(nop.remote(*refs)),
+              results, count=NUM_ARGS)
+        del refs
+
+        probe("many task returns",
+              lambda: ray.get(
+                  nop_returns.options(num_returns=NUM_RETURNS)
+                  .remote(NUM_RETURNS)),
+              results, count=NUM_RETURNS)
+
+        objs = [ray.put(i) for i in range(NUM_GET)]
+        probe("ray.get many objects",
+              lambda: ray.get(objs),
+              results, count=NUM_GET)
+        del objs
+
+        def queue_many():
+            batch = [nop.remote() for _ in range(NUM_QUEUED)]
+            ray.get(batch)
+
+        probe("queue many tasks", queue_many, results, count=NUM_QUEUED)
+
+        # large object: bounded by free shm (value + serialized copy)
+        free_gib = 4.0
+        try:
+            st = os.statvfs("/dev/shm")
+            free_gib = st.f_bavail * st.f_frsize / (1 << 30)
+        except OSError:
+            pass
+        gib = min(LARGE_GIB_CAP, max(0.25, free_gib * 0.35))
+        arr = np.zeros(int(gib * (1 << 30) // 8), dtype=np.int64)
+        ref_large = ray.put(arr)
+        del arr
+        t0 = time.perf_counter()
+        out = ray.get(ref_large)
+        dt = time.perf_counter() - t0
+        ref = REFERENCE["large object get"]
+        print(f"large object get: {gib:.2f} GiB in {dt:.2f} s "
+              f"({gib / dt:.2f} GiB/s; ref {ref['gib']} GiB in "
+              f"{ref['seconds']} s = {ref['gib'] / ref['seconds']:.2f} GiB/s)",
+              flush=True)
+        results.append({
+            "name": "large object get", "seconds": round(dt, 2),
+            "gib": round(gib, 2), "gib_per_s": round(gib / dt, 2),
+            "reference": ref,
+            "note": "size capped by free /dev/shm on this host",
+        })
+        del out
+    finally:
+        ray.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    from ray_tpu.microbenchmark import write_bench_json
+
+    out = main()
+    write_bench_json("BENCH_envelope.json", {"probes": out})

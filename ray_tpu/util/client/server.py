@@ -176,9 +176,51 @@ class ClientServer:
 
         sess = self._session(session_id)
         refs = [sess.refs[i] for i in ref_ids]
+        for r in refs:
+            # a pipelined submission that failed server-side parks its
+            # exception under the client-assigned rid (client_tasks_batch)
+            if isinstance(r, Exception):
+                raise r
         values = await self._in_thread(
             ray.get, refs, timeout=get_timeout)
         return serialization.dumps(values)
+
+    async def client_tasks_batch(self, session_id: str,
+                                 items: list) -> bool:
+        """Pipelined task submissions: the client pre-assigned the ref
+        ids, so ONE RPC carries many .remote() calls and needs no
+        per-call reply (reference: the Ray Client datapath pipelines
+        task ops on its gRPC stream instead of round-tripping each;
+        python/ray/util/client/dataclient.py). Submission errors are
+        parked under the assigned rid and re-raised by client_get."""
+        sess = self._session(session_id)
+
+        def submit_all():
+            for it in items:
+                rids = it["ref_ids"]
+                try:
+                    args, kwargs = self._load_args(sess, it["args_blob"])
+                    if it["kind"] == "task":
+                        fn = sess.funcs[it["func_id"]]
+                        if it.get("options"):
+                            fn = fn.options(**it["options"])
+                        refs = fn.remote(*args, **kwargs)
+                    else:
+                        m = getattr(sess.actors[it["actor_id"]],
+                                    it["method_name"])
+                        if it.get("num_returns") is not None:
+                            m = m.options(num_returns=it["num_returns"])
+                        refs = m.remote(*args, **kwargs)
+                    if not isinstance(refs, (list, tuple)):
+                        refs = [refs]
+                    for rid, ref in zip(rids, refs):
+                        sess.refs[rid] = ref
+                except Exception as e:  # noqa: BLE001 — parked per-rid
+                    for rid in rids:
+                        sess.refs[rid] = e
+
+        await self._in_thread(submit_all)
+        return True
 
     async def client_wait(self, session_id: str, ref_ids: list,
                           num_returns: int = 1,
@@ -186,12 +228,30 @@ class ClientServer:
         import ray_tpu as ray
 
         sess = self._session(session_id)
-        refs = [sess.refs[i] for i in ref_ids]
-        ready, pending = await self._in_thread(
-            ray.wait, refs, num_returns=num_returns,
-            timeout=wait_timeout)
-        return {"ready": [r.id.hex() for r in ready],
-                "pending": [r.id.hex() for r in pending]}
+        # failed pipelined submissions count as 'ready' (their get
+        # raises — matching ray.wait semantics for errored refs), but
+        # the reply still honors len(ready) == num_returns
+        failed = [i for i in ref_ids
+                  if isinstance(sess.refs.get(i), Exception)]
+        live_ids = [i for i in ref_ids
+                    if not isinstance(sess.refs.get(i), Exception)]
+        need = max(0, min(num_returns, len(ref_ids)) - len(failed))
+        ready_ids: list = []
+        if need and live_ids:
+            # dedupe instances for the ray.wait call; readiness then
+            # applies to every rid aliasing a ready instance
+            uniq = list({id(sess.refs[i]): sess.refs[i]
+                         for i in live_ids}.values())
+            ready, _pending = await self._in_thread(
+                ray.wait, uniq, num_returns=min(need, len(uniq)),
+                timeout=wait_timeout)
+            ready_set = {id(r) for r in ready}
+            ready_ids = [i for i in live_ids
+                         if id(sess.refs[i]) in ready_set]
+        out_ready = (failed + ready_ids)[:num_returns]
+        taken = set(out_ready)
+        pending_ids = [i for i in ref_ids if i not in taken]
+        return {"ready": out_ready, "pending": pending_ids}
 
     async def client_release(self, session_id: str, ref_ids: list) -> bool:
         sess = self._session(session_id)

@@ -63,14 +63,27 @@ class ClientRemoteMethod:
 
     def remote(self, *args, **kwargs):
         w = self._handle._worker
-        ids = w._call(
-            "client_actor_task",
-            actor_id=self._handle.actor_id,
-            method_name=self._name,
-            args_blob=client_dumps((args, kwargs)),
-            num_returns=self._num_returns,
-        )
-        refs = [ClientObjectRef(i, w) for i in ids]
+        n = self._num_returns if self._num_returns is not None else 1
+        if not isinstance(n, int) or n < 1:
+            # streaming / exotic returns: plain round-trip
+            ids = w._call(
+                "client_actor_task",
+                actor_id=self._handle.actor_id,
+                method_name=self._name,
+                args_blob=client_dumps((args, kwargs)),
+                num_returns=self._num_returns,
+            )
+            refs = [ClientObjectRef(i, w) for i in ids]
+            return refs[0] if len(refs) == 1 else refs
+        # pipelined: client assigns the rids, the submission rides the
+        # next batched flush (see ClientWorker._flush_tasks)
+        refs = w._queue_task({
+            "kind": "actor_task",
+            "actor_id": self._handle.actor_id,
+            "method_name": self._name,
+            "args_blob": client_dumps((args, kwargs)),
+            "num_returns": self._num_returns,
+        }, n)
         return refs[0] if len(refs) == 1 else refs
 
 
@@ -117,13 +130,25 @@ class ClientRemoteFunction:
                 options=self._base_options,
             )
             self._registered = True
-        ids = w._call(
-            "client_task",
-            func_id=self._func_id,
-            args_blob=client_dumps((args, kwargs)),
-            options=self._call_options,
-        )
-        refs = [ClientObjectRef(i, w) for i in ids]
+        n = 1
+        for opts in (self._base_options, self._call_options or {}):
+            n = opts.get("num_returns", n)
+        if not isinstance(n, int) or n < 1:
+            # streaming / exotic returns: plain round-trip
+            ids = w._call(
+                "client_task",
+                func_id=self._func_id,
+                args_blob=client_dumps((args, kwargs)),
+                options=self._call_options,
+            )
+            refs = [ClientObjectRef(i, w) for i in ids]
+            return refs[0] if len(refs) == 1 else refs
+        refs = w._queue_task({
+            "kind": "task",
+            "func_id": self._func_id,
+            "args_blob": client_dumps((args, kwargs)),
+            "options": self._call_options,
+        }, n)
         return refs[0] if len(refs) == 1 else refs
 
 
@@ -169,6 +194,9 @@ class ClientWorker:
         self._client = RpcClient(host, port)
         self._lock = threading.Lock()
         self._released: List[str] = []
+        self._pending_tasks: List[dict] = []
+        self._flush_timer_armed = False
+        self._send_lock = threading.Lock()
         self._closed = False
         res = self._call("client_connect", _no_session=True,
                          namespace=namespace)
@@ -203,6 +231,9 @@ class ClientWorker:
     def _call(self, method: str, _no_session: bool = False, **kwargs):
         if not _no_session:
             kwargs["session_id"] = self.session_id
+        # pipelined submissions must land before any dependent op (and
+        # before releases: a submission binds rids a release might name)
+        self._flush_tasks()
         self._flush_released()
         return self._client.call_sync(
             method,
@@ -210,6 +241,67 @@ class ClientWorker:
             idempotent=method not in self._NON_IDEMPOTENT,
             **kwargs,
         )
+
+    # -- pipelined task submission -------------------------------------
+    def _queue_task(self, item: dict, num_returns: int):
+        """Assign rids client-side and queue the submission; ONE
+        client_tasks_batch RPC carries the whole burst (reference: the
+        client datapath stream pipelines task ops). A 5 ms timer flushes
+        fire-and-forget submissions that no later RPC would carry."""
+        rids = [f"r-{uuid.uuid4().hex}" for _ in range(max(1, num_returns))]
+        item["ref_ids"] = rids
+        arm = False
+        with self._lock:
+            self._pending_tasks.append(item)
+            n = len(self._pending_tasks)
+            if not self._flush_timer_armed:
+                self._flush_timer_armed = arm = True
+        if n >= 200:
+            self._flush_tasks()
+        elif arm:
+            t = threading.Timer(0.005, self._timer_flush)
+            t.daemon = True
+            t.start()
+        return [ClientObjectRef(i, self) for i in rids]
+
+    def _timer_flush(self):
+        with self._lock:
+            self._flush_timer_armed = False
+        try:
+            self._flush_tasks()
+        except Exception:
+            # batch was re-queued by _flush_tasks; retry on a backoff
+            # timer so fire-and-forget submissions still eventually land
+            with self._lock:
+                if self._flush_timer_armed or self._closed:
+                    return
+                self._flush_timer_armed = True
+            t = threading.Timer(0.2, self._timer_flush)
+            t.daemon = True
+            t.start()
+
+    def _flush_tasks(self):
+        # _send_lock serializes swap+send: a dependent RPC entering
+        # _call blocks here until the in-flight batch has actually
+        # reached the server, so client_get can never overtake the
+        # submission that binds its rid
+        with self._send_lock:
+            with self._lock:
+                if not self._pending_tasks:
+                    return
+                batch, self._pending_tasks = self._pending_tasks, []
+            try:
+                self._client.call_sync(
+                    "client_tasks_batch", timeout=300.0, idempotent=False,
+                    session_id=self.session_id, items=batch,
+                )
+            except Exception:
+                # put the batch back (order preserved) — the next _call
+                # or backoff timer retries; a permanently dead server
+                # fails the caller's own RPC instead
+                with self._lock:
+                    self._pending_tasks[:0] = batch
+                raise
 
     # -- ref lifetime -------------------------------------------------
     def _mark_released(self, ref_id: str):

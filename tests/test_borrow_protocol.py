@@ -1,0 +1,132 @@
+"""Borrower protocol: serialization pins replace the fixed grace window.
+
+The reference confirms borrows synchronously at deserialization
+(reference: src/ray/core_worker/reference_count.h:73 "borrowers" +
+WaitForRefRemoved). ray_tpu's redesign: every OUT-OF-BAND pickle of an
+ObjectRef mints a token pin on the owner record; the deserializer's
+borrow registration consumes the token; pins expire after
+``borrow_pin_ttl_s`` into a clean ObjectLostError (never garbage).
+Containers stored via ray.put retain their nested refs for the
+container record's lifetime, and task completions are held until the
+executor's new borrow registrations are flushed.
+
+These tests deliberately sleep PAST the old 5 s grace window the pins
+replaced, proving the object's survival no longer depends on it.
+"""
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu._private import serialization
+
+# sleeps must beat the round-2 fixed grace (5.0 s) to prove the new
+# protocol, not the old sleep, keeps objects alive
+PAST_OLD_GRACE_S = 6.0
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 4})
+    yield
+    ray.shutdown()
+
+
+@ray.remote
+class Stash:
+    """Holds opaque bytes / refs across calls."""
+
+    def __init__(self):
+        self.blob = None
+        self.ref = None
+
+    def put_blob(self, blob):
+        self.blob = blob
+        return True
+
+    def load_and_read(self):
+        ref = serialization.loads(self.blob)
+        return ray.get(ref)
+
+    def stash_nested(self, container):
+        self.ref = container["r"]
+        return True
+
+    def read_stashed(self):
+        return ray.get(self.ref)
+
+
+def test_deserialize_long_after_owner_drop(ray_start):
+    """Out-of-band pickled ref: bytes deserialized PAST the old grace
+    window (with every live handle long dropped) still read the value —
+    the serialization pin held the object until registration."""
+    ref = ray.put({"payload": 123})
+    blob = serialization.dumps(ref)
+
+    s = Stash.remote()
+    assert ray.get(s.put_blob.remote(blob))
+
+    del ref
+    gc.collect()
+    time.sleep(PAST_OLD_GRACE_S)
+
+    assert ray.get(s.load_and_read.remote()) == {"payload": 123}
+
+
+def test_expired_pin_is_clean_loss(ray_start):
+    """After the pin TTL expires with no registration, the object is
+    freed and a late deserializer gets ObjectLostError — never garbage."""
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    old_ttl = cfg.borrow_pin_ttl_s
+    cfg.borrow_pin_ttl_s = 0.3
+    try:
+        ref = ray.put("doomed")
+        blob = serialization.dumps(ref)
+        del ref
+        gc.collect()
+        time.sleep(1.2)  # pin expired -> owner freed the record
+    finally:
+        cfg.borrow_pin_ttl_s = old_ttl
+
+    late = serialization.loads(blob)
+    with pytest.raises(ray.ObjectLostError):
+        ray.get(late)
+
+
+def test_put_container_retains_nested_refs(ray_start):
+    """A stored container (shm path) pins its nested refs for the
+    container's lifetime: reading them through the container works long
+    after the direct handles died, with no TTL involved."""
+    inner = ray.put("nested-value")
+    # > max_inline_object_size so the container takes the shm path
+    container = ray.put({"pad": np.zeros(130_000, dtype=np.int8),
+                         "r": inner})
+    del inner
+    gc.collect()
+    time.sleep(PAST_OLD_GRACE_S)
+
+    @ray.remote
+    def read_through(c):
+        return ray.get(c["r"])
+
+    assert ray.get(read_through.remote(container)) == "nested-value"
+
+
+def test_actor_stashes_nested_arg_ref(ray_start):
+    """Completion-carry: an actor stashing a nested arg ref keeps it
+    readable after the submitter drops every handle — the completion
+    reply was held until the executor's borrow registration flushed, so
+    the owner could not free in between."""
+    obj = ray.put("stashed-value")
+    s = Stash.remote()
+    assert ray.get(s.stash_nested.remote({"r": obj}))
+
+    del obj
+    gc.collect()
+    time.sleep(PAST_OLD_GRACE_S)
+
+    assert ray.get(s.read_stashed.remote()) == "stashed-value"
