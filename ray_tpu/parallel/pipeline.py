@@ -105,6 +105,13 @@ def pipeline_apply(
             inject = x_all[jnp.minimum(t, M - 1)]
             current = jnp.where(s == 0, inject, current)
             out, aux = stage(current)
+            # keep the carried activation's GSPMD sharding identical to
+            # the injected input's: without this, fsdp-sharded layer
+            # matmuls leave `out` d-sharded while `inject` is
+            # replicated, and the select reconciling them forces an
+            # involuntary full rematerialization every tick
+            out = jax.lax.with_sharding_constraint(
+                out, P(*[None] * out.ndim))
             # stage s holds microbatch (t - s); its aux only counts when
             # that microbatch index is real
             valid = (t >= s) & (t - s < M)

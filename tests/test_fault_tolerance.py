@@ -98,7 +98,9 @@ def test_actor_task_retry_across_restart(cluster, tmp_path):
     assert ray.get(p.maybe_crash.remote(), timeout=120) == "rose"
 
 
-def test_node_death_detected_and_actor_restarts_elsewhere(cluster):
+def test_node_death_detected(cluster):
+    """GCS health checks mark a killed node dead; an actor pinned to it
+    by a node-unique resource cannot restart and its calls fail."""
     node = cluster.add_node(resources={"CPU": 2, "doomed": 1})
     time.sleep(1.5)
 
@@ -127,6 +129,65 @@ def test_node_death_detected_and_actor_restarts_elsewhere(cluster):
             break
         time.sleep(0.5)
     assert dead_seen, "GCS did not mark the killed node dead"
+
+
+def test_actor_restarts_elsewhere_after_node_death(cluster, tmp_path):
+    """A restartable actor placed on a node that dies comes back on a
+    SURVIVING node and serves restored state (reference:
+    gcs_actor_manager.h:333 restart-on-new-node semantics). Soft node
+    affinity steers first placement to the doomed node; after the kill
+    the scheduler must fall back to the head node."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    node = cluster.add_node(resources={"CPU": 2})
+    time.sleep(1.5)
+    state_file = str(tmp_path / "survivor_state")
+
+    @ray.remote(max_restarts=2, max_task_retries=4)
+    class Phoenix:
+        def __init__(self):
+            # restore-hook pattern: incarnation count persists across
+            # restarts (both 'nodes' share this host's filesystem)
+            n = 0
+            if os.path.exists(state_file):
+                with open(state_file) as f:
+                    n = int(f.read() or 0)
+            self.incarnation = n + 1
+            with open(state_file, "w") as f:
+                f.write(str(self.incarnation))
+
+        def whoami(self):
+            import ray_tpu.api as api
+
+            return api.global_worker().node_id, self.incarnation
+
+    p = Phoenix.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node.node_id, soft=True)
+    ).remote()
+    first_node, inc = ray.get(p.whoami.remote(), timeout=150)
+    assert first_node == node.node_id
+    assert inc == 1
+
+    node.kill_raylet()
+
+    # retried calls ride out death detection + restart; the actor must
+    # come back on the survivor (the head node) with restored state
+    deadline = time.time() + 90
+    last_err = None
+    while time.time() < deadline:
+        try:
+            where, inc = ray.get(p.whoami.remote(), timeout=30)
+            if where != node.node_id:
+                assert inc == 2, f"state not restored: incarnation={inc}"
+                return
+        except ray.RayError as e:  # transient while restarting
+            last_err = e
+        time.sleep(1.0)
+    raise AssertionError(
+        f"actor did not restart on the surviving node: {last_err}")
 
 
 def test_lineage_reconstruction_of_lost_object(cluster):
