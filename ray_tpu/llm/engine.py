@@ -183,6 +183,11 @@ class LLMEngine:
             return jnp.where(temps <= 0.0, greedy,
                              sampled).astype(jnp.int32)
 
+        # The multi-step fns RETURN their token/length feedback so the
+        # host can chain ticks device-to-device: on a tunneled chip the
+        # d2h readback dominates the tick (~24 ms measured vs ~0.1 ms
+        # dispatch/upload), so the loop pipelines — dispatch tick N,
+        # async-copy its tokens, and only then process tick N-1's.
         if chunk > 1 and not self.paged:
             def decode_multi(params, cache, tokens, lengths, active,
                              temps, key):
@@ -195,9 +200,9 @@ class LLMEngine:
                     return (cache, tok[:, None], lens), tok
 
                 keys = jax.random.split(key, chunk)
-                (cache, _, _), toks = jax.lax.scan(
+                (cache, last, lens), toks = jax.lax.scan(
                     step, (cache, tokens, lengths), keys)
-                return toks, cache  # toks [chunk, B]
+                return toks, last, lens, cache  # toks [chunk, B]
 
             self._decode_multi = jax.jit(decode_multi,
                                          donate_argnums=(1,))
@@ -215,12 +220,33 @@ class LLMEngine:
                     return (pages, tok[:, None], lens), tok
 
                 keys = jax.random.split(key, chunk)
-                (pages, _, _), toks = jax.lax.scan(
+                (pages, last, lens), toks = jax.lax.scan(
                     step, (pages, tokens, lengths), keys)
-                return toks, pages
+                return toks, last, lens, pages
 
             self._decode_multi_paged = jax.jit(decode_multi_paged,
                                                donate_argnums=(1,))
+        # device-resident (last_tokens, lengths) chained between multi-
+        # step ticks; None = host state changed, re-upload next tick
+        self._dev_state = None
+        # in-flight (tokens_device, active, chunk) from the last
+        # dispatched tick, consumed after the NEXT dispatch
+        self._pending_tick = None
+        # admissions whose first token was sampled ON DEVICE and not yet
+        # copied to the host: list of (slot, req, token_dev). The copy
+        # merges into the next tick readback — an admission costs no d2h
+        # round trip of its own.
+        self._pending_first: list = []
+        # (slot, token_dev, length) updates to fold into the device
+        # chain right before the next dispatch
+        self._chain_fixups: list = []
+        # device-side first-token sampling + chain scatter helpers
+        self._sample_first = jax.jit(
+            lambda logits, temp, key: _sample_on_device(
+                logits[None, :], jnp.asarray([temp]), key)[0])
+        self._admit_scatter = jax.jit(
+            lambda toks, lens, idx, tok, ln: (
+                toks.at[idx, 0].set(tok), lens.at[idx].set(ln)))
         self._sample_base_key = jax.random.PRNGKey(seed ^ 0x5EED)
         self._tick_counter = 0
 
@@ -434,6 +460,10 @@ class LLMEngine:
                     )
                 self.lengths[:] = 0
                 self.slots = [None] * self.ecfg.max_batch_size
+                # the pipelined tick and device feedback chain reference
+                # the donated (now rebuilt) buffers — reset both
+                self._pending_tick = None
+                self._dev_state = None
                 time.sleep(0.05)
 
     def _finish_with_error(self, i: int, err: str):
@@ -451,10 +481,19 @@ class LLMEngine:
         req.event.set()
 
     def _loop_once(self, jnp):
-            admitted = self._admit()
+            self._admit()
+            if self._dev_state is None:
+                # broken chain (host-sampled admission, single-step
+                # fallback, or error recovery): the host mirrors must
+                # fold in EVERY dispatched tick before they are
+                # re-uploaded, or the next tick replays the in-flight
+                # one (double-appending its tokens)
+                self._drain_pending_tick()
             active = [i for i, s in enumerate(self.slots) if s is not None]
             if not active:
-                if not admitted:
+                if self._pending_tick is not None:
+                    self._drain_pending_tick()
+                elif not admitted:
                     time.sleep(0.002)
                 return
             last_tokens = np.zeros(
@@ -466,6 +505,9 @@ class LLMEngine:
                     req.generated[-1] if req.generated else req.prompt[-1]
                 )
             chunk = max(1, self.ecfg.decode_chunk)
+            # with a tick in flight the device lengths run ahead of the
+            # host mirror by up to one chunk — keep that margin in bounds
+            margin = chunk * (2 if self._pending_tick is not None else 1)
             use_multi = (
                 chunk > 1
                 and all(
@@ -474,13 +516,27 @@ class LLMEngine:
                     for i in active
                 )
                 # overshoot inside the chunk must stay within bounds
-                and int(self.lengths[active].max()) + chunk
+                and int(self.lengths[active].max()) + margin
                 < self.ecfg.max_seq_len
             )
             if use_multi:
                 self._decode_chunk(jnp, active, last_tokens, chunk)
                 return
-            # single batched decode step for every active slot
+            # single batched decode step for every active slot: host
+            # sampling needs host lengths to be exact — drain the
+            # pipelined tick and resolve device-held first tokens first
+            self._drain_pending_tick()
+            self._resolve_pending_first()
+            self._dev_state = None
+            self._chain_fixups.clear()
+            active = [i for i, s in enumerate(self.slots) if s is not None]
+            if not active:
+                return
+            for i in active:
+                req = self.slots[i]
+                last_tokens[i, 0] = (
+                    req.generated[-1] if req.generated else req.prompt[-1]
+                )
             if self.paged:
                 logits, self.pages = self._decode_paged(
                     self.params,
@@ -508,10 +564,15 @@ class LLMEngine:
                 self._maybe_finish(i)
 
     def _decode_chunk(self, jnp, active, last_tokens, chunk):
-        """Multi-step decode: `chunk` tokens in ONE dispatch, sampling
-        on device; only the token ids cross to the host. Tokens past a
-        request's stop are discarded (the cache positions they wrote
-        are beyond the request's final length and are never read)."""
+        """Multi-step decode, PIPELINED: `chunk` tokens per dispatch with
+        on-device sampling, token/length feedback chained device-side
+        (no per-tick upload), and the token readback of tick N consumed
+        only after tick N+1 is dispatched — the ~24 ms tunneled-d2h
+        latency overlaps the next tick's compute instead of serializing
+        with it. Tokens past a request's stop are discarded (the cache
+        positions they wrote are beyond the request's final length and
+        are never read; device lengths for continuing slots stay exact
+        because only finishing conditions truncate a chunk)."""
         jax = self._jax
         B = self.ecfg.max_batch_size
         active_mask = np.zeros(B, dtype=np.int32)
@@ -522,22 +583,94 @@ class LLMEngine:
         self._tick_counter += 1
         key = jax.random.fold_in(self._sample_base_key,
                                  self._tick_counter)
+        if self._dev_state is not None:
+            tokens_in, lengths_in = self._dev_state
+        else:
+            tokens_in = jnp.asarray(last_tokens)
+            lengths_in = jnp.asarray(self.lengths)
+        # fold freshly admitted slots into the chain ON DEVICE (their
+        # first tokens exist only there; see _pending_first)
+        if self._chain_fixups:
+            for slot, tok_dev, ln in self._chain_fixups:
+                tokens_in, lengths_in = self._admit_scatter(
+                    tokens_in, lengths_in, slot, tok_dev, ln)
+            self._chain_fixups.clear()
         if self.paged:
-            toks, self.pages = self._decode_multi_paged(
-                self.params, self.pages, jnp.asarray(last_tokens),
-                jnp.asarray(self.page_tables), jnp.asarray(self.lengths),
+            toks, last, lens, self.pages = self._decode_multi_paged(
+                self.params, self.pages, tokens_in,
+                jnp.asarray(self.page_tables), lengths_in,
                 jnp.asarray(active_mask), jnp.asarray(temps), key,
             )
         else:
-            toks, self.cache = self._decode_multi(
-                self.params, self.cache, jnp.asarray(last_tokens),
-                jnp.asarray(self.lengths), jnp.asarray(active_mask),
+            toks, last, lens, self.cache = self._decode_multi(
+                self.params, self.cache, tokens_in,
+                lengths_in, jnp.asarray(active_mask),
                 jnp.asarray(temps), key,
             )
-        toks_np = np.asarray(toks)  # [chunk, B]
+        self._dev_state = (last, lens)
+        try:
+            toks.copy_to_host_async()
+        except Exception:
+            pass  # backend without async copy: np.asarray blocks later
+        # capture request IDENTITY, not just slot index: a slot can be
+        # freed and re-admitted between this dispatch and the consume,
+        # and the new occupant must not inherit the old one's tokens
+        prev, self._pending_tick = (
+            self._pending_tick,
+            (toks, [(i, self.slots[i]) for i in active], chunk))
+        if prev is not None:
+            self._consume_tick(*prev)
+
+    def _drain_pending_tick(self):
+        prev, self._pending_tick = self._pending_tick, None
+        if prev is not None:
+            self._consume_tick(*prev)
+        elif self._pending_first:
+            self._resolve_pending_first()
+
+    def _resolve_pending_first(self):
+        """Copy device-held first tokens to the host (outside a tick
+        readback — used by the single-step fallback and idle drains)."""
+        pend, self._pending_first = self._pending_first, []
+        for slot, req, tok_dev in pend:
+            if self.slots[slot] is not req:
+                continue
+            req.generated.append(int(np.asarray(tok_dev)))
+            self._maybe_finish(slot)
+
+    def _consume_tick(self, toks_dev, active, chunk):
+        """Fold a completed tick's tokens into host state. Device-held
+        first tokens of freshly admitted slots merge into the SAME d2h
+        transfer (one concatenated array), so admissions never pay
+        their own tunnel round trip. Finished slots do NOT break the
+        device chain: their rows go inactive, and the garbage their
+        stale lengths produce lands on the paged layout's sacrificial
+        page 0 / the dead slab rows, both rewritten at the next
+        admission."""
+        jnp = self._jnp
+        pend, self._pending_first = self._pending_first, []
+        if pend:
+            firsts = jnp.stack([t for _s, _r, t in pend])
+            merged = np.asarray(
+                jnp.concatenate([toks_dev.reshape(-1),
+                                 firsts.astype(toks_dev.dtype)]))
+            B = self.ecfg.max_batch_size
+            toks_np = merged[: chunk * B].reshape(chunk, B)
+            first_np = merged[chunk * B:]
+            # first tokens PRECEDE this tick's tokens for their slots
+            # (the tick containing those slots is still in flight or is
+            # this very one — fold order preserves sequence order)
+            for (slot, req, _t), tok in zip(pend, first_np):
+                if self.slots[slot] is not req:
+                    continue
+                req.generated.append(int(tok))
+                self._maybe_finish(slot)
+        else:
+            toks_np = np.asarray(toks_dev)  # [chunk, B]
         now = time.time()
-        for i in active:
-            req = self.slots[i]
+        for i, req in active:
+            if req is None or self.slots[i] is not req:
+                continue  # freed (or slot re-admitted) since dispatch
             consumed = 0
             for step in range(chunk):
                 req.generated.append(int(toks_np[step, i]))
@@ -615,6 +748,12 @@ class LLMEngine:
                 self.slots[i] = req
                 admitted = True
                 self._maybe_finish(i)
+                if self.slots[i] is not None:
+                    # splice the transferred first token into the live
+                    # decode chain (value is host-known; upload is cheap)
+                    self._chain_fixups.append(
+                        (i, jnp.asarray(int(first_tok), jnp.int32),
+                         len(req.prompt)))
                 continue
             to_prefill.append((i, req, bucket))
             self.slots[i] = req  # reserve the slot now
@@ -686,14 +825,8 @@ class LLMEngine:
                                   dtype=jnp.int32)
                 self.cache = self._scatter_slots(
                     self.cache, cacheB, idx)
-            logits_np = np.asarray(last_logits)
-            now = time.time()
-            for j, (i, req, _b) in enumerate(items):
-                self.lengths[i] = len(req.prompt)
-                tok = self._sample(logits_np[j], req.params)
-                req.generated.append(int(tok))
-                req.first_token_time = now
-                self._maybe_finish(i)
+            self._finish_admissions(
+                [(i, req) for i, req, _b in items], last_logits)
 
     def _prefill_one(self, i, req, bucket):
         jnp = self._jnp
@@ -720,11 +853,39 @@ class LLMEngine:
                 "k": self.cache["k"].at[:, i].set(cache1["k"][:, 0]),
                 "v": self.cache["v"].at[:, i].set(cache1["v"][:, 0]),
             }
-        self.lengths[i] = len(req.prompt)
-        tok = self._sample(np.asarray(last_logits), req.params)
-        req.generated.append(int(tok))
-        req.first_token_time = time.time()
-        self._maybe_finish(i)
+        self._finish_admissions([(i, req)], last_logits[None, :])
+
+    def _finish_admissions(self, items, last_logits):
+        """Install admitted requests' first tokens. Device-sampleable
+        requests (greedy/temperature) sample ON DEVICE, defer the host
+        copy to the next tick readback, and scatter straight into the
+        decode feedback chain — an admission costs zero extra d2h round
+        trips. Host-sampled requests (top_k / per-request seed) read the
+        logits back and break the chain (rare path)."""
+        jax = self._jax
+        jnp = self._jnp
+        logits_np = None
+        now = time.time()
+        for j, (i, req) in enumerate(items):
+            self.lengths[i] = len(req.prompt)
+            req.first_token_time = now
+            if req.params.top_k in (0, None) and req.params.seed is None:
+                self._tick_counter += 1
+                key = jax.random.fold_in(self._sample_base_key,
+                                         self._tick_counter)
+                tok_dev = self._sample_first(
+                    last_logits[j], np.float32(req.params.temperature),
+                    key)
+                self._pending_first.append((i, req, tok_dev))
+                self._chain_fixups.append(
+                    (i, tok_dev, len(req.prompt)))
+            else:
+                if logits_np is None:
+                    logits_np = np.asarray(last_logits)
+                tok = self._sample(logits_np[j], req.params)
+                req.generated.append(int(tok))
+                self._dev_state = None  # host mirrors are authoritative
+                self._maybe_finish(i)
 
     def _reserve_pages(self, i: int, req: "_Request", bucket: int) -> bool:
         """Allocate exactly the pages this request can ever touch:
