@@ -47,6 +47,11 @@ def _subprocess_env() -> dict:
     env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
     # Daemons never touch jax; skip the TPU runtime hook (saves ~2s per
     # process start and leaves the chip claimable by actual TPU workers).
+    # The original value is preserved so the raylet can still DETECT the
+    # tunneled chips and hand them to TPU-leasing workers.
+    pool = env.get("PALLAS_AXON_POOL_IPS", "")
+    if pool and "RAY_TPU_AXON_POOL" not in env:
+        env["RAY_TPU_AXON_POOL"] = pool
     env["PALLAS_AXON_POOL_IPS"] = ""
     return env
 

@@ -72,6 +72,19 @@ def detect_node_resources() -> Tuple[Dict[str, float], Dict[str, str]]:
                 chips = str(n)
         except Exception:
             pass
+    if not chips:
+        # tunneled chips (axon relay): one chip per pool endpoint.
+        # Detected from env only — importing jax here would CLAIM the
+        # chip for the raylet process and starve the actual workers.
+        # RAY_TPU_AXON_POOL preserves the value across the daemon spawn
+        # (node.py clears PALLAS_AXON_POOL_IPS for daemons).
+        pool = (os.environ.get("PALLAS_AXON_POOL_IPS", "")
+                or os.environ.get("RAY_TPU_AXON_POOL", ""))
+        if pool.strip():
+            ips = [p for p in pool.split(",") if p.strip()]
+            chips = str(len(ips))
+            accel_type = accel_type or (
+                "tpu-" + os.environ.get("PALLAS_AXON_TPU_GEN", "unknown"))
     if not accel_type and os.environ.get("RAY_TPU_GCE_METADATA") == "1":
         accel_type = _gce_metadata("instance/attributes/accelerator-type")
     if chips:
@@ -506,6 +519,11 @@ class Raylet:
             # jax at the backend we just disabled.
             env["PALLAS_AXON_POOL_IPS"] = ""
             env["JAX_PLATFORMS"] = "cpu"
+        elif tpu > 0 and os.environ.get("RAY_TPU_AXON_POOL"):
+            # tunneled chips: restore the runtime hook the daemon spawn
+            # cleared, so this worker's jax binds the axon backend
+            env["PALLAS_AXON_POOL_IPS"] = os.environ["RAY_TPU_AXON_POOL"]
+            env["JAX_PLATFORMS"] = "axon"
         elif tpu > 0:
             # Partition the host's chips: a k-chip lease gets a worker
             # that sees exactly k chips (reference: TPU_VISIBLE_CHIPS

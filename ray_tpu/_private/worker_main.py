@@ -16,6 +16,41 @@ from .ids import JobID
 from .rpc import RpcConnectionError
 
 
+def _start_stack_sampler(path: str, hz: float):
+    """Built-in sampling profiler (py-spy is not in the image): a
+    daemon thread periodically aggregates every thread's Python stack
+    and rewrites `path` with the top stacks, ranked by sample count.
+    Enable with RAY_TPU_STACK_SAMPLER=/tmp/prefix (one file per
+    worker pid). Diagnostic aid only — off unless the env var is set."""
+    import collections
+    import sys
+    import threading
+    import traceback
+
+    counts: "collections.Counter[str]" = collections.Counter()
+
+    def run():
+        n = 0
+        while True:
+            time.sleep(1.0 / hz)
+            for tid, frame in sys._current_frames().items():
+                if tid == threading.get_ident():
+                    continue
+                stack = "".join(traceback.format_stack(frame, limit=12))
+                counts[stack] += 1
+            n += 1
+            if n % max(1, int(hz)) == 0:  # rewrite ~once per second
+                try:
+                    with open(path, "w") as f:
+                        for stack, c in counts.most_common(15):
+                            f.write(f"=== {c} samples ===\n{stack}\n")
+                except OSError:
+                    pass
+
+    threading.Thread(target=run, daemon=True,
+                     name="stack-sampler").start()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--raylet-host", required=True)
@@ -31,6 +66,13 @@ def main():
     cfg_json = os.environ.get("RAY_TPU_CONFIG_JSON")
     if cfg_json:
         set_config(Config.from_json(cfg_json))
+
+    sampler_path = os.environ.get("RAY_TPU_STACK_SAMPLER")
+    if sampler_path:
+        _start_stack_sampler(
+            f"{sampler_path}.{os.getpid()}",
+            float(os.environ.get("RAY_TPU_STACK_SAMPLER_HZ", "50")),
+        )
 
     worker = CoreWorker(
         mode="worker",

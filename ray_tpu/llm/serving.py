@@ -85,12 +85,7 @@ class LLMServer:
             top_k=int(payload.get("top_k", 0)),
             stop_token_ids=tuple(payload.get("stop_token_ids", ())),
         )
-        import asyncio
-
-        loop = asyncio.get_running_loop()
-        result = await loop.run_in_executor(
-            None, lambda: self.engine.generate(prompt, params)
-        )
+        result = await self.engine.agenerate(prompt, params)
         text = self._decode_text(result.token_ids)
         choice: Dict[str, Any] = {
             "index": 0,
@@ -148,4 +143,5 @@ def build_openai_app(
 def _tpu_visible() -> bool:
     import os
 
-    return bool(os.environ.get("TPU_CHIPS"))
+    return bool(os.environ.get("TPU_CHIPS")
+                or os.environ.get("PALLAS_AXON_POOL_IPS", "").strip())

@@ -141,16 +141,54 @@ class ServeController:
                     except Exception:
                         pass
 
+        now = time.monotonic()
         for name, cfg in list(self._configs.items()):
             replicas = self._replicas.setdefault(name, [])
-            # drop dead replicas (actor died / unreachable)
+            # drop dead replicas (actor died / unreachable); probes are
+            # throttled per replica (reference default ~10 s) — a
+            # health RPC every reconcile tick measurably steals CPU
+            # from busy replicas on small hosts. A replica that has
+            # never passed a health check is STARTING, not unhealthy:
+            # its __init__ may legitimately run for minutes (model
+            # load + device compiles), and replacing it mid-boot both
+            # leaks the booting actor AND deadlocks exclusive
+            # resources (the replacement can never acquire the TPU
+            # chip the leaked one holds). Reference: deployment_state
+            # distinguishes STARTING/UNHEALTHY with a slow-start
+            # grace, replica.py health-check semantics.
+            grace = float(cfg.get("startup_grace_s", 600.0))
             alive = []
             for rep in replicas:
+                rep.setdefault("created_at", now)
+                if now - rep.get("last_health", 0.0) < 5.0:
+                    alive.append(rep)
+                    continue
                 try:
                     ray.get(rep["handle"].check_health.remote(), timeout=10)
+                    rep["last_health"] = now
+                    rep["started"] = True
+                    rep["health_fails"] = 0
                     alive.append(rep)
                 except Exception:
-                    pass
+                    if not rep.get("started") and (
+                            now - rep["created_at"] < grace):
+                        alive.append(rep)  # still booting
+                        continue
+                    # tolerate transient stalls (recompiles, CPU
+                    # contention): only 3 consecutive failed probes
+                    # mark a started replica dead (reference:
+                    # health_check_failure_threshold)
+                    rep["health_fails"] = rep.get("health_fails", 0) + 1
+                    rep["last_health"] = now  # throttle re-probes too
+                    if rep["health_fails"] < 3:
+                        alive.append(rep)
+                        continue
+                    # genuinely unhealthy: reap it so its resources
+                    # (TPU chips) free up before the replacement spawns
+                    try:
+                        ray.kill(rep["handle"])
+                    except Exception:
+                        pass
             replicas[:] = alive
             target = self._target_replicas(name)
             while len(replicas) < target:
