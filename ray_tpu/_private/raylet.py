@@ -188,7 +188,10 @@ class Raylet:
         self._cfg = cfg
 
         auto_res, auto_labels = detect_node_resources()
-        self.total = dict(resources) if resources else auto_res
+        # explicit resources OVERLAY detection (reference: ray.init
+        # resources add/override; accelerators stay auto-detected —
+        # full replacement silently strips the node's TPUs)
+        self.total = {**auto_res, **(resources or {})}
         self.labels = {**auto_labels, **(labels or {})}
         self.available = dict(self.total)
         self.is_head = is_head
@@ -519,11 +522,6 @@ class Raylet:
             # jax at the backend we just disabled.
             env["PALLAS_AXON_POOL_IPS"] = ""
             env["JAX_PLATFORMS"] = "cpu"
-        elif tpu > 0 and os.environ.get("RAY_TPU_AXON_POOL"):
-            # tunneled chips: restore the runtime hook the daemon spawn
-            # cleared, so this worker's jax binds the axon backend
-            env["PALLAS_AXON_POOL_IPS"] = os.environ["RAY_TPU_AXON_POOL"]
-            env["JAX_PLATFORMS"] = "axon"
         elif tpu > 0:
             # Partition the host's chips: a k-chip lease gets a worker
             # that sees exactly k chips (reference: TPU_VISIBLE_CHIPS
@@ -549,6 +547,19 @@ class Raylet:
                 # owns every chip (tracked so later subset spawns evict
                 # this worker instead of double-claiming devices)
                 chips = tuple(range(total_chips))
+            pool = os.environ.get("RAY_TPU_AXON_POOL", "")
+            if pool:
+                # tunneled chips: restore the runtime hook the daemon
+                # spawn cleared, handing this worker exactly its leased
+                # endpoints (one pool IP per chip id; same accounting
+                # as TPU_VISIBLE_CHIPS so concurrent leases never bind
+                # the same endpoint)
+                ips = [p.strip() for p in pool.split(",") if p.strip()]
+                own = chips if chips else tuple(range(len(ips)))
+                env["PALLAS_AXON_POOL_IPS"] = ",".join(
+                    ips[c] for c in own if c < len(ips))
+                env["JAX_PLATFORMS"] = "axon"
+                env.pop("TPU_VISIBLE_CHIPS", None)
         # runtime env applied at spawn (reference: runtime_env_agent
         # prepares the env before the worker starts, runtime_env_agent.py:165)
         cwd = None
