@@ -1175,6 +1175,15 @@ class CoreWorker:
             return
         if ref.owner_address and tuple(ref.owner_address) != self.address:
             self._queue_pin_notify(tuple(ref.owner_address), key, token)
+            # count the queued pin in the executing task's borrow scope:
+            # the completion reply must not race ahead of the pin-add
+            # (an executor holding NO borrow entry — e.g. a top-level
+            # ref arg pickled into the return — would otherwise let the
+            # owner release arg retention and free the record before
+            # _rpc_add_pins lands, turning the pin into a silent no-op)
+            scope = _task_borrow_scope
+            if getattr(scope, "armed", False):
+                scope.created = getattr(scope, "created", 0) + 1
 
     def _consume_pin_locked(self, rec: _ObjectRecord, token: bytes):
         """Consume a serialization pin (caller holds _records_lock)."""
@@ -1692,16 +1701,17 @@ class CoreWorker:
             return pool
 
     def _on_task_done(self, spec: dict, returns: List[tuple], node_id: str,
-                      stream_error=None, notify: bool = True):
+                      stream_error=None, notify: bool = True) -> bool:
         """Submitter callback with the executor's reply. Idempotent: a
         streamed per-task completion (report_task_done) and the batch
-        reply may both carry the same result."""
+        reply may both carry the same result. Returns True iff THIS call
+        transitioned the task (so batch callers count each task once)."""
         task_id = spec["task_id"]
         with self._records_lock:
             task = self._tasks.get(task_id)
             if task is not None:
                 if task.status in ("FINISHED", "FAILED"):
-                    return
+                    return False
                 task.status = "FINISHED"
                 if task.stream is not None:
                     # the executor awaited every item report before
@@ -1754,6 +1764,7 @@ class CoreWorker:
             self._notify_ready()
             self._count("ray_tpu_tasks_finished_total",
                         "tasks finished successfully")
+        return True
 
     def _on_task_failed(self, spec: dict, error: Exception) -> bool:
         """Returns True if the task will be retried."""
@@ -2085,9 +2096,8 @@ class CoreWorker:
         for task_id, returns in items:
             with self._records_lock:
                 task = self._tasks.get(task_id)
-            if task is not None:
-                self._on_task_done(task.spec, returns, node_id,
-                                   notify=False)
+            if task is not None and self._on_task_done(
+                    task.spec, returns, node_id, notify=False):
                 n += 1
         if n:
             self._notify_ready()
@@ -2495,13 +2505,17 @@ class CoreWorker:
                 result = await method(*args, **kwargs)
             except Exception as e:  # noqa: BLE001
                 return self._actor_error_reply(spec, e)
+            def _pack_confirmed():
+                # packing may pickle refs out-of-band (pin-adds): hold
+                # this reply until those pins are flushed to owners
+                with _confirmed_borrows(self):
+                    return {
+                        "returns": self._pack_returns(spec, result),
+                        "node_id": self.node_id,
+                    }
+
             return await loop.run_in_executor(
-                self._task_executor,
-                lambda: {
-                    "returns": self._pack_returns(spec, result),
-                    "node_id": self.node_id,
-                },
-            )
+                self._task_executor, _pack_confirmed)
         return await loop.run_in_executor(
             self._actor_executor, self._execute_actor_task_sync, spec
         )
@@ -3632,14 +3646,17 @@ class _LeasePool:
                     self.enqueue(spec)
             asyncio.ensure_future(self._pump())
             return
-        for spec, res in zip(specs, reply["results"]):
+        n = sum(
             w._on_task_done(spec, res["returns"], reply["node_id"],
                             stream_error=res.get("stream_error"),
                             notify=False)
+            for spec, res in zip(specs, reply["results"])
+        )
         if specs:
             w._notify_ready()
+        if n:
             w._count("ray_tpu_tasks_finished_total",
-                     "tasks finished successfully", len(specs))
+                     "tasks finished successfully", n)
         with self.lock:
             # SPREAD leases are single-use: reuse would pin the whole burst
             # to whichever node answered first (reference: spread policy
@@ -3947,14 +3964,17 @@ class _ActorSubmitter:
                 await self._pump()
             return
         self._abandoned.difference_update(sent_abandoned)
-        for sp, res in zip(specs, reply["results"]):
+        n = sum(
             w._on_task_done(sp, res["returns"], res["node_id"],
                             stream_error=res.get("stream_error"),
                             notify=False)
+            for sp, res in zip(specs, reply["results"])
+        )
         if specs:
             w._notify_ready()
+        if n:
             w._count("ray_tpu_tasks_finished_total",
-                     "tasks finished successfully", len(specs))
+                     "tasks finished successfully", n)
 
     async def _send(self, spec: dict):
         w = self.worker

@@ -31,6 +31,7 @@ import os
 import shutil
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 
@@ -126,6 +127,54 @@ class RuntimeEnvManager:
                 pythonpath=(moddir,) if os.path.isdir(moddir) else (),
             )
         os.makedirs(envdir, exist_ok=True)
+        # cross-PROCESS build guard (the asyncio lock covers only this
+        # raylet): O_EXCL lock file; a second raylet sharing the session
+        # dir waits for the winner's .ready instead of corrupting the
+        # half-built venv. A stale lock (builder killed mid-build) is
+        # broken after its mtime ages past the build timeout.
+        lockfile = os.path.join(envdir, ".building")
+        deadline = time.time() + 660
+        while True:
+            try:
+                fd = os.open(lockfile, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+            except FileExistsError:
+                if os.path.exists(marker):
+                    return _EnvState(
+                        python=venv_py if os.path.exists(venv_py) else None,
+                        pythonpath=(moddir,) if os.path.isdir(moddir)
+                        else (),
+                    )
+                try:
+                    age = time.time() - os.path.getmtime(lockfile)
+                except OSError:
+                    continue  # winner just removed it; retry
+                if age > 660 or time.time() > deadline:
+                    try:
+                        os.unlink(lockfile)  # stale: builder died
+                    except OSError:
+                        pass
+                    continue
+                time.sleep(0.2)
+                continue
+            except FileNotFoundError:
+                # a failing builder rmtree'd envdir between our checks —
+                # recreate and take over the build
+                os.makedirs(envdir, exist_ok=True)
+                continue
+            # lock won — but the previous holder may have JUST finished:
+            # honor its .ready instead of rebuilding over a live venv
+            if os.path.exists(marker):
+                try:
+                    os.unlink(lockfile)
+                except OSError:
+                    pass
+                return _EnvState(
+                    python=venv_py if os.path.exists(venv_py) else None,
+                    pythonpath=(moddir,) if os.path.isdir(moddir) else (),
+                )
+            break
         log = open(logpath, "ab")
         try:
             python, pythonpath = None, []
@@ -138,6 +187,10 @@ class RuntimeEnvManager:
                     self._build_py_modules(envdir, mods, python, log))
             with open(marker, "w") as f:
                 f.write("ok")
+            try:
+                os.unlink(lockfile)
+            except OSError:
+                pass
             return _EnvState(python=python, pythonpath=tuple(pythonpath))
         except Exception:
             log.flush()

@@ -192,12 +192,19 @@ class ClientServer:
         per-call reply (reference: the Ray Client datapath pipelines
         task ops on its gRPC stream instead of round-tripping each;
         python/ray/util/client/dataclient.py). Submission errors are
-        parked under the assigned rid and re-raised by client_get."""
+        parked under the assigned rid and re-raised by client_get.
+
+        The RPC is retried by the client after connection loss, and the
+        lost batch may already have executed here — items whose first
+        ref id is already bound are SKIPPED, so a retry never submits a
+        task twice (client-assigned rids double as dedup keys)."""
         sess = self._session(session_id)
 
         def submit_all():
             for it in items:
                 rids = it["ref_ids"]
+                if rids and rids[0] in sess.refs:
+                    continue  # duplicate delivery of an applied item
                 try:
                     args, kwargs = self._load_args(sess, it["args_blob"])
                     if it["kind"] == "task":
