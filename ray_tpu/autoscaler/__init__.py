@@ -3,7 +3,8 @@
 Reference: python/ray/autoscaler/v2/autoscaler.py:47 (Autoscaler),
 v2/scheduler.py:638 (ResourceDemandScheduler bin-packing),
 v2/instance_manager/reconciler.py (instance state machine),
-autoscaler/node_provider.py:13 (NodeProvider plugin ABC).
+autoscaler/node_provider.py:13 (NodeProvider plugin ABC),
+_private/gcp/node_provider.py:1 (cloud provider example).
 
 TPU-native reframing: node types are *slices* — a node type carries the
 resources and labels of one TPU host (or slice gang); the scheduler
@@ -14,15 +15,47 @@ terminates nodes idle past the timeout.
 from .config import AutoscalingConfig, NodeTypeConfig
 from .node_provider import NodeProvider
 from .fake_provider import FakeNodeProvider
+from .gke_provider import GkeTpuNodeProvider
 from .scheduler import ResourceDemandScheduler
 from .autoscaler import Autoscaler, StandardAutoscaler
+
+
+def make_provider(provider_config: dict, config: AutoscalingConfig,
+                  **kwargs) -> NodeProvider:
+    """Construct a provider from a cluster-config ``provider`` section
+    (reference: the launcher YAML's ``provider.type`` dispatch,
+    autoscaler/_private/providers.py)."""
+    ptype = provider_config.get("type", "fake")
+    if ptype in ("gke", "gcp-tpu", "tpu"):
+        # forward only the kwargs this provider understands: generic
+        # call sites also pass fake-provider plumbing (gcs_address,
+        # session_dir) that must not reach the cloud provider
+        gke_kw = {k: v for k, v in kwargs.items()
+                  if k in ("transport", "token_provider",
+                           "poll_interval_s")}
+        return GkeTpuNodeProvider(
+            config,
+            project=provider_config["project_id"],
+            zone=provider_config["availability_zone"],
+            cluster_name=provider_config.get("cluster_name", "ray-tpu"),
+            use_queued_resources=provider_config.get(
+                "use_queued_resources", True),
+            **gke_kw,
+        )
+    if ptype == "fake":
+        return FakeNodeProvider(config, kwargs.get("gcs_address"),
+                                session_dir=kwargs.get("session_dir"))
+    raise ValueError(f"unknown provider type {ptype!r}")
+
 
 __all__ = [
     "AutoscalingConfig",
     "NodeTypeConfig",
     "NodeProvider",
     "FakeNodeProvider",
+    "GkeTpuNodeProvider",
     "ResourceDemandScheduler",
     "Autoscaler",
     "StandardAutoscaler",
+    "make_provider",
 ]
