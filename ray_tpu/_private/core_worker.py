@@ -471,6 +471,8 @@ class CoreWorker:
         self._caller_queues: Dict[str, _CallerQueue] = {}
         self._max_concurrency = 1
         self._actor_executor: Optional[ThreadPoolExecutor] = None
+        self._group_executors: Dict[str, ThreadPoolExecutor] = {}
+        self._group_semaphores: Dict[str, "asyncio.Semaphore"] = {}
         self._task_executor = ThreadPoolExecutor(
             max_workers=max(4, (os.cpu_count() or 4))
         )
@@ -1856,6 +1858,7 @@ class CoreWorker:
         max_restarts: int = 0,
         max_task_retries: int = 0,
         max_concurrency: int = 1,
+        concurrency_groups: Optional[Dict[str, int]] = None,
         detached: bool = False,
         strategy: str = "DEFAULT",
         strategy_params: Optional[dict] = None,
@@ -1878,6 +1881,7 @@ class CoreWorker:
                 "args": packed_args,
                 "kwargs": packed_kwargs,
                 "max_concurrency": max_concurrency,
+                "concurrency_groups": dict(concurrency_groups or {}),
                 "actor_id": actor_id,
                 "owner_address": list(self.address),
             }
@@ -1945,6 +1949,7 @@ class CoreWorker:
         num_returns: int = 1,
         max_task_retries: int = 0,
         tensor_transport: Optional[str] = None,
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         task_id = TaskID.for_job(self.job_id)
         streaming = num_returns == "streaming"
@@ -1966,6 +1971,8 @@ class CoreWorker:
         }
         if tensor_transport:
             spec["tensor_transport"] = tensor_transport
+        if concurrency_group:
+            spec["concurrency_group"] = concurrency_group
         from ..util import tracing as _tracing
 
         _tracing.stamp_spec(spec)
@@ -2368,6 +2375,21 @@ class CoreWorker:
         self._actor_executor = ThreadPoolExecutor(
             max_workers=self._max_concurrency
         )
+        # named concurrency groups (reference:
+        # concurrency_group_manager.h): each group is an execution lane
+        # with its own cap — a dedicated thread pool for sync methods
+        # and a semaphore bounding interleaved async methods. Methods
+        # outside any group use the default max_concurrency lane.
+        groups = info.get("concurrency_groups") or {}
+        self._group_executors = {
+            g: ThreadPoolExecutor(max_workers=max(1, int(n)),
+                                  thread_name_prefix=f"cg-{g}")
+            for g, n in groups.items()
+        }
+        self._group_semaphores = {
+            g: asyncio.Semaphore(max(1, int(n)))
+            for g, n in groups.items()
+        }
         return {"ok": True, "address": list(self.address)}
 
     async def _rpc_push_actor_task(self, spec: dict, seq: int, caller: str,
@@ -2448,7 +2470,10 @@ class CoreWorker:
                 is_async = method is not None and asyncio.iscoroutinefunction(
                     method
                 )
-                serialize = self._max_concurrency == 1 and not is_async
+                # group-routed methods run in their own lane: never
+                # serialize them into the default seq-ordered execution
+                serialize = (self._max_concurrency == 1 and not is_async
+                             and not spec.get("concurrency_group"))
                 if serialize:
                     # full execution serialization in seq order
                     try:
@@ -2495,6 +2520,13 @@ class CoreWorker:
                 spec,
                 AttributeError(f"actor has no method {spec['method']!r}"),
             )
+        group = spec.get("concurrency_group")
+        if group and group not in self._group_executors:
+            # a typo'd group must not silently run uncapped next to
+            # serialized methods (reference raises for undeclared groups)
+            return self._actor_error_reply(spec, ValueError(
+                f"concurrency group {group!r} not declared on this "
+                f"actor (has: {sorted(self._group_executors)})"))
         if asyncio.iscoroutinefunction(method):
             # arg refs may need network fetches — never block the io
             # loop resolving them (call_sync from the loop deadlocks)
@@ -2502,7 +2534,12 @@ class CoreWorker:
                 args, kwargs = await loop.run_in_executor(
                     self._task_executor, self._unpack_args_confirmed, spec
                 )
-                result = await method(*args, **kwargs)
+                sem = self._group_semaphores.get(group) if group else None
+                if sem is not None:
+                    async with sem:
+                        result = await method(*args, **kwargs)
+                else:
+                    result = await method(*args, **kwargs)
             except Exception as e:  # noqa: BLE001
                 return self._actor_error_reply(spec, e)
             def _pack_confirmed():
@@ -2517,7 +2554,9 @@ class CoreWorker:
             return await loop.run_in_executor(
                 self._task_executor, _pack_confirmed)
         return await loop.run_in_executor(
-            self._actor_executor, self._execute_actor_task_sync, spec
+            self._group_executors.get(group, self._actor_executor)
+            if group else self._actor_executor,
+            self._execute_actor_task_sync, spec
         )
 
     def _unpack_args_confirmed(self, spec: dict):

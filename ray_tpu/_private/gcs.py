@@ -107,6 +107,15 @@ class GcsServer:
         self._persist_path = persist_path
         self._dirty = asyncio.Event()
         self._restored = False
+        # critical-mutation durability (reference: Redis writes are
+        # per-mutation): registrations await _persist_critical, which
+        # guarantees a snapshot COVERING the caller's mutation is on
+        # disk before the registration RPC returns. Concurrent callers
+        # coalesce into one write via sequence numbers — a burst of
+        # registrations costs ~2 snapshot writes, not one each.
+        self._mut_seq = 0
+        self._persisted_seq = 0
+        self._persist_writing: Optional[asyncio.Task] = None
         if persist_path and os.path.exists(persist_path):
             self._load_snapshot(persist_path)
 
@@ -147,7 +156,47 @@ class GcsServer:
 
     def _mark_dirty(self):
         if self._persist_path:
+            self._mut_seq += 1
             self._dirty.set()
+
+    async def _persist_critical(self):
+        """Block until a snapshot covering every mutation made so far is
+        durably on disk. Used by registrations whose loss on kill -9
+        would be user-visible (a just-registered detached actor must
+        survive a GCS restart). No-op without a persist path. On
+        persistent write failure (disk full, unpicklable entry) it
+        gives up after a few attempts with a loud log — availability
+        over durability, but never a silent false claim or a hot loop
+        stalling the control plane."""
+        if not self._persist_path:
+            return
+        target = self._mut_seq
+        attempts = 0
+        while self._persisted_seq < target:
+            if self._persist_writing is None or \
+                    self._persist_writing.done():
+                attempts += 1
+                if attempts > 3:
+                    print(
+                        "[gcs] WARNING: critical persistence failing — "
+                        "registration is NOT durable", flush=True)
+                    return
+                self._persist_writing = asyncio.ensure_future(
+                    self._persist_covering())
+            try:
+                await asyncio.shield(self._persist_writing)
+            except Exception:  # noqa: BLE001 — counted via attempts
+                pass
+
+    async def _persist_covering(self):
+        seq = self._mut_seq  # snapshot taken on-loop covers up to here
+        data = self._snapshot_bytes()
+        if data is None:
+            return
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, self._write_snapshot, data)
+        if ok:
+            self._persisted_seq = max(self._persisted_seq, seq)
 
     def _snapshot_bytes(self) -> Optional[bytes]:
         """Pickle the durable tables. Runs on the event loop so the
@@ -168,14 +217,16 @@ class GcsServer:
             print(f"[gcs] snapshot pickle failed: {e}", flush=True)
             return None
 
-    def _write_snapshot(self, data: bytes):
+    def _write_snapshot(self, data: bytes) -> bool:
         try:
             tmp = self._persist_path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(data)
             os.replace(tmp, self._persist_path)
+            return True
         except Exception as e:  # noqa: BLE001
             print(f"[gcs] snapshot write failed: {e}", flush=True)
+            return False
 
     def _persist_now(self):
         """Synchronous snapshot (shutdown path)."""
@@ -538,6 +589,7 @@ class GcsServer:
                                           "start_time": time.time()}
         self._mark_dirty()
         self._publish("JOB", {"event": "added", "job": job_info})
+        await self._persist_critical()
         return True
 
     async def mark_job_finished(self, job_id: str):
@@ -589,6 +641,9 @@ class GcsServer:
         self._emit("ACTOR_REGISTERED", aid, name=name or "",
                    job_id=spec.get("job_id"))
         self._kick_schedulers()
+        # registration is durable before the caller proceeds (detached
+        # actors especially must survive an immediate GCS kill -9)
+        await self._persist_critical()
         return {"ok": True}
 
     async def _scheduling_loop(self):
@@ -872,6 +927,7 @@ class GcsServer:
         self._pending_pgs.append(pgid)
         self._mark_dirty()
         self._kick_schedulers()
+        await self._persist_critical()
         return {"ok": True}
 
     async def _try_schedule_pg(self, pgid: str, pg: dict) -> bool:

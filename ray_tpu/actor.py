@@ -20,26 +20,32 @@ from .remote_function import _demand_from_options, _strategy_from_options
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str,
                  num_returns: int = 1,
-                 tensor_transport: Optional[str] = None):
+                 tensor_transport: Optional[str] = None,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
         self._tensor_transport = tensor_transport
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
             self._name, args, kwargs, num_returns=self._num_returns,
             tensor_transport=self._tensor_transport,
+            concurrency_group=self._concurrency_group,
         )
 
     def options(self, num_returns: Optional[int] = None,
-                tensor_transport: Optional[str] = "__unset__"):
+                tensor_transport: Optional[str] = "__unset__",
+                concurrency_group: Optional[str] = "__unset__"):
         return ActorMethod(
             self._handle,
             self._name,
             self._num_returns if num_returns is None else num_returns,
             self._tensor_transport if tensor_transport == "__unset__"
             else tensor_transport,
+            self._concurrency_group if concurrency_group == "__unset__"
+            else concurrency_group,
         )
 
     def bind(self, *args):
@@ -75,12 +81,13 @@ class ActorHandle:
                 return ActorMethod(
                     self, name, m.get("num_returns", 1),
                     m.get("tensor_transport"),
+                    m.get("concurrency_group"),
                 )
             return ActorMethod(self, name, m)
         raise AttributeError(f"actor has no method {name!r}")
 
     def _actor_method_call(self, method_name, args, kwargs, num_returns=1,
-                           tensor_transport=None):
+                           tensor_transport=None, concurrency_group=None):
         worker = global_worker()
         refs = worker.submit_actor_task(
             self._actor_id,
@@ -90,6 +97,7 @@ class ActorHandle:
             num_returns=num_returns,
             max_task_retries=self._max_task_retries,
             tensor_transport=tensor_transport,
+            concurrency_group=concurrency_group,
         )
         if num_returns == 1:
             return refs[0]
@@ -116,24 +124,34 @@ def _public_methods(cls) -> Dict[str, Any]:
             continue
         num_returns = getattr(fn, "_ray_num_returns", 1)
         transport = getattr(fn, "_ray_tensor_transport", None)
-        if transport:
-            methods[name] = {"num_returns": num_returns,
-                             "tensor_transport": transport}
+        group = getattr(fn, "_ray_concurrency_group", None)
+        if transport or group:
+            methods[name] = {"num_returns": num_returns}
+            if transport:
+                methods[name]["tensor_transport"] = transport
+            if group:
+                methods[name]["concurrency_group"] = group
         else:
             methods[name] = num_returns
     return methods
 
 
-def method(num_returns: int = 1, tensor_transport: Optional[str] = None):
-    """@ray_tpu.method(num_returns=N, tensor_transport="device") on actor
-    methods (reference: python/ray/actor.py `method` decorator;
-    tensor_transport mirrors the RDT `@ray.method(tensor_transport=...)`
-    option — returns stay in the producer's device memory)."""
+def method(num_returns: int = 1, tensor_transport: Optional[str] = None,
+           concurrency_group: Optional[str] = None):
+    """@ray_tpu.method(num_returns=N, tensor_transport="device",
+    concurrency_group="io") on actor methods (reference:
+    python/ray/actor.py `method` decorator; tensor_transport mirrors the
+    RDT `@ray.method(tensor_transport=...)` option — returns stay in the
+    producer's device memory; concurrency_group routes the method to a
+    named executor lane with its own concurrency cap, reference
+    core_worker/transport/concurrency_group_manager.h)."""
 
     def decorator(fn):
         fn._ray_num_returns = num_returns
         if tensor_transport:
             fn._ray_tensor_transport = tensor_transport
+        if concurrency_group:
+            fn._ray_concurrency_group = concurrency_group
         return fn
 
     return decorator
@@ -191,6 +209,7 @@ class ActorClass:
             max_restarts=o.get("max_restarts", 0),
             max_task_retries=o.get("max_task_retries", 0),
             max_concurrency=o.get("max_concurrency", 1),
+            concurrency_groups=o.get("concurrency_groups"),
             detached=lifetime == "detached",
             strategy=strategy,
             strategy_params=params,

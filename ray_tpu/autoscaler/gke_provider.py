@@ -49,7 +49,9 @@ Transport = Callable[[str, str, Optional[dict], Dict[str, str]],
 
 
 class GkeTpuError(RuntimeError):
-    pass
+    def __init__(self, msg: str, status: int = 0):
+        super().__init__(msg)
+        self.status = status
 
 
 def _metadata_token() -> str:
@@ -118,6 +120,7 @@ class GkeTpuNodeProvider(NodeProvider):
         self.transport = transport or _urllib_transport
         self.token_provider = token_provider or _metadata_token
         self.poll_interval_s = poll_interval_s
+        self._last_refresh = 0.0
         self._lock = threading.Lock()
         # provider_id -> {"node_type", "node_id", "state", "qr_name"}
         self._nodes: Dict[str, dict] = {}
@@ -127,7 +130,7 @@ class GkeTpuNodeProvider(NodeProvider):
     # REST plumbing
     # ------------------------------------------------------------------
     def _call(self, method: str, path: str, body: Optional[dict] = None,
-              *, retries: int = 3) -> dict:
+              *, retries: int = 3, ok_statuses: tuple = ()) -> dict:
         url = f"{TPU_API}/{path}" if not path.startswith("http") else path
         headers = {
             "Authorization": f"Bearer {self.token_provider()}",
@@ -136,7 +139,7 @@ class GkeTpuNodeProvider(NodeProvider):
         backoff = 1.0
         for attempt in range(retries):
             status, payload = self.transport(method, url, body, headers)
-            if status < 300:
+            if status < 300 or status in ok_statuses:
                 return payload
             if status in (429, 500, 502, 503) and attempt + 1 < retries:
                 time.sleep(backoff)
@@ -144,7 +147,7 @@ class GkeTpuNodeProvider(NodeProvider):
                 continue
             raise GkeTpuError(
                 f"{method} {url} -> {status}: "
-                f"{payload.get('error', payload)}")
+                f"{payload.get('error', payload)}", status)
         raise GkeTpuError(f"{method} {url}: retries exhausted")
 
     # ------------------------------------------------------------------
@@ -174,12 +177,19 @@ class GkeTpuNodeProvider(NodeProvider):
             }
             topo = nt.labels.get("tpu-topology")
             if topo:
-                # explicit topology requests use acceleratorConfig
+                # explicit topology requests use acceleratorConfig —
+                # the API rejects requests carrying BOTH acceleratorType
+                # and acceleratorConfig, so the type moves inside it
+                node_body.pop("acceleratorType")
                 node_body["acceleratorConfig"] = {
                     "type": accel.split("-")[0].replace(
                         "v5litepod", "V5LITE_POD").upper(),
                     "topology": topo,
                 }
+            # 409 = this id already exists: a retried create whose
+            # first attempt landed before a transient 5xx — success,
+            # NOT an error (raising would leak the billable slice
+            # untracked)
             if self.use_queued_resources:
                 qr_name = pid
                 body = {
@@ -197,13 +207,13 @@ class GkeTpuNodeProvider(NodeProvider):
                     "POST",
                     f"{self._parent}/queuedResources"
                     f"?queuedResourceId={qr_name}",
-                    body,
+                    body, ok_statuses=(409,),
                 )
             else:
                 qr_name = None
                 self._call(
                     "POST", f"{self._parent}/nodes?nodeId={pid}",
-                    node_body,
+                    node_body, ok_statuses=(409,),
                 )
             with self._lock:
                 self._nodes[pid] = {
@@ -223,18 +233,21 @@ class GkeTpuNodeProvider(NodeProvider):
         try:
             if rec.get("qr_name"):
                 # deleting the queued resource releases the slice too
-                # (force covers ACTIVE resources with a provisioned node)
+                # (force covers ACTIVE resources with a provisioned
+                # node); 404 = already gone — that IS terminated
                 self._call(
                     "DELETE",
                     f"{self._parent}/queuedResources/"
                     f"{rec['qr_name']}?force=true",
+                    ok_statuses=(404,),
                 )
             else:
                 self._call("DELETE",
-                           f"{self._parent}/nodes/{provider_id}")
+                           f"{self._parent}/nodes/{provider_id}",
+                           ok_statuses=(404,))
         except GkeTpuError:
-            # a 404 means it's already gone; other errors re-track the
-            # node so the reconciler retries the terminate
+            # transient failure: re-track the node so the reconciler
+            # retries the terminate
             with self._lock:
                 self._nodes.setdefault(provider_id, rec)
             raise
@@ -267,10 +280,15 @@ class GkeTpuNodeProvider(NodeProvider):
     def _refresh_states(self):
         """One LIST call refreshes every tracked node's provisioning
         state (reference: cached DescribeInstances; per-node GETs would
-        hammer the API at scale)."""
+        hammer the API at scale). Throttled by poll_interval_s — the
+        reconciler calls non_terminated_nodes every loop tick."""
+        now = time.monotonic()
         with self._lock:
             if not self._nodes:
                 return
+            if now - self._last_refresh < self.poll_interval_s:
+                return
+            self._last_refresh = now
             track_qr = any(r.get("qr_name") for r in self._nodes.values())
         states: Dict[str, str] = {}
         if track_qr:

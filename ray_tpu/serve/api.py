@@ -14,6 +14,7 @@ from .controller import CONTROLLER_NAME, ServeController
 from .handle import DeploymentHandle
 
 _PROXY_NAME = "SERVE_PROXY"
+_GRPC_PROXY_NAME = "SERVE_GRPC_PROXY"
 
 
 class Application:
@@ -100,6 +101,22 @@ def _get_or_create_proxy(http_host: str, http_port: int):
         return handle
 
 
+def _get_or_create_grpc_proxy(host: str, port: int):
+    import ray_tpu as ray
+
+    from .grpc_proxy import GrpcProxyActor
+
+    try:
+        return ray.get_actor(_GRPC_PROXY_NAME)
+    except ValueError:
+        Proxy = ray.remote(GrpcProxyActor)
+        handle = Proxy.options(
+            name=_GRPC_PROXY_NAME, lifetime="detached", max_concurrency=64,
+        ).remote(host, port)
+        ray.get(handle.address.remote(), timeout=60)
+        return handle
+
+
 def run(
     target: Application | Deployment,
     *,
@@ -107,6 +124,7 @@ def run(
     route_prefix: Optional[str] = "/",
     http_host: str = "127.0.0.1",
     http_port: int = 8000,
+    grpc_port: Optional[int] = None,
     blocking: bool = False,
     _http: bool = True,
 ) -> DeploymentHandle:
@@ -145,15 +163,21 @@ def run(
     else:
         raise TimeoutError(f"deployment {dep.name} has no replicas")
 
-    if _http:
-        proxy = _get_or_create_proxy(http_host, http_port)
-        routes = {}
+    routes = {}
+    if _http or grpc_port is not None:
         deps = ray.get(controller.get_deployments.remote(), timeout=30)
         for dname, cfg in deps.items():
             prefix = cfg.get("route_prefix")
             if prefix:
                 routes[prefix] = dname
+    if _http:
+        proxy = _get_or_create_proxy(http_host, http_port)
         ray.get(proxy.update_routes.remote(routes=routes), timeout=30)
+    if grpc_port is not None:
+        # second ingress (reference runs HTTP + gRPC proxies side by
+        # side, proxy.py:520): same routing table, same handles
+        gproxy = _get_or_create_grpc_proxy(http_host, grpc_port)
+        ray.get(gproxy.update_routes.remote(routes=routes), timeout=30)
 
     handle = DeploymentHandle(dep.name)
     if blocking:  # pragma: no cover
@@ -209,5 +233,10 @@ def shutdown():
     try:
         proxy = ray.get_actor(_PROXY_NAME)
         ray.kill(proxy)
+    except Exception:
+        pass
+    try:
+        gproxy = ray.get_actor(_GRPC_PROXY_NAME)
+        ray.kill(gproxy)
     except Exception:
         pass

@@ -1,0 +1,142 @@
+"""gRPC ingress: the second data plane next to the HTTP proxy.
+
+Reference: serve/_private/proxy.py:520 (gRPCProxy) — the reference runs
+HTTP and gRPC ingresses side by side; gRPC requests resolve to the same
+router/replica path as HTTP. Here the service surface is a generic
+bytes-in/bytes-out unary API (grpc's generic handler — no generated
+stubs needed), mirroring the reference's RayServeAPIService control
+methods plus a data-plane Route method:
+
+  /ray_tpu.serve.RayServeAPIService/Healthz          -> b"ok"
+  /ray_tpu.serve.RayServeAPIService/ListApplications -> JSON app list
+  /ray_tpu.serve.GenericService/Route                -> JSON in/out
+
+Route request body (JSON): {"application": <route_prefix or app name>,
+"payload": <user payload>, "multiplexed_model_id": optional}. The reply
+body is the deployment's JSON-serialized return value. Multiplexing and
+routing behave exactly like the HTTP path (same DeploymentHandle).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+from typing import Any, Dict
+
+HEALTHZ = "/ray_tpu.serve.RayServeAPIService/Healthz"
+LIST_APPS = "/ray_tpu.serve.RayServeAPIService/ListApplications"
+ROUTE = "/ray_tpu.serve.GenericService/Route"
+
+
+class GrpcProxyActor:
+    """One gRPC server actor fronting every deployment (data plane)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        import grpc
+
+        self.host = host
+        self.port = port
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._handles: Dict[str, Any] = {}
+        self._num_requests = 0
+        outer = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                if method == HEALTHZ:
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: b"ok")
+                if method == LIST_APPS:
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._list_applications)
+                if method == ROUTE:
+                    return grpc.unary_unary_rpc_method_handler(
+                        outer._route)
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="grpc-proxy"),
+            handlers=(_Handler(),),
+        )
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(f"could not bind gRPC proxy on {host}:{port}")
+        self.port = bound
+        self._server.start()
+
+    # -- control methods ----------------------------------------------
+    def _list_applications(self, request: bytes, context) -> bytes:
+        return json.dumps(sorted(self._routes.values())).encode()
+
+    # -- data plane ----------------------------------------------------
+    def _route(self, request: bytes, context) -> bytes:
+        import grpc
+
+        self._num_requests += 1
+        try:
+            body = json.loads(request or b"{}")
+        except ValueError:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "request body must be JSON")
+            return b""
+        app = body.get("application", "")
+        target = self._routes.get(app)
+        if target is None:
+            # fall back to longest-prefix match like the HTTP proxy
+            longest = -1
+            for prefix, dep in self._routes.items():
+                if app.startswith(prefix) and len(prefix) > longest:
+                    target, longest = dep, len(prefix)
+        if target is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no application for {app!r}")
+            return b""
+        handle = self._handles.get(target)
+        if handle is None:
+            from .handle import DeploymentHandle
+
+            handle = DeploymentHandle(target)
+            self._handles[target] = handle
+        model_id = body.get("multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        try:
+            result = handle.remote(body.get("payload")).result(timeout=120)
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+            return b""
+        if isinstance(result, bytes):
+            return result
+        return json.dumps(result).encode()
+
+    # -- actor surface -------------------------------------------------
+    def update_routes(self, routes: Dict[str, str]) -> bool:
+        self._routes = dict(routes)
+        return True
+
+    def address(self):
+        return [self.host, self.port]
+
+    def get_num_requests(self) -> int:
+        return self._num_requests
+
+
+def channel_route(address: str, application: str, payload: Any,
+                  timeout: float = 120.0,
+                  multiplexed_model_id: str = "") -> Any:
+    """Client helper: one Route call over an insecure channel."""
+    import grpc
+
+    body = {"application": application, "payload": payload}
+    if multiplexed_model_id:
+        body["multiplexed_model_id"] = multiplexed_model_id
+    with grpc.insecure_channel(address) as ch:
+        fn = ch.unary_unary(ROUTE)
+        reply = fn(json.dumps(body).encode(), timeout=timeout)
+    try:
+        return json.loads(reply)
+    except ValueError:
+        return reply

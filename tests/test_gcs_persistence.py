@@ -72,6 +72,33 @@ def test_detached_actor_survives_gcs_restart(ray_start):
         raise AssertionError(f"actor unreachable after restart: {last_err}")
 
 
+def test_registration_durable_without_debounce_window(ray_start):
+    """kill -9 the GCS IMMEDIATELY after a detached registration — no
+    debounce sleep. register_actor awaits a covering snapshot before
+    replying (flush-on-critical-mutation; reference Redis writes are
+    per-mutation durable), so the actor must survive."""
+    a = KeepAlive.options(
+        name="persist-now", lifetime="detached"
+    ).remote()
+    assert ray.get(a.bump.remote(), timeout=60) == 1
+    # NO sleep: the registration reply already implies durability
+    _restart_gcs()
+
+    deadline = time.monotonic() + 30
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            h = ray.get_actor("persist-now")
+            assert ray.get(h.bump.remote(), timeout=10) == 2
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(0.5)
+    else:
+        raise AssertionError(
+            f"actor lost in the debounce window: {last_err}")
+
+
 def test_kv_and_jobs_survive_gcs_restart(ray_start):
     w = ray_api.global_worker()
     w.gcs.kv_put(ns="persist_test", key="k1", value=b"v1")

@@ -37,7 +37,12 @@ class MockTpuService:
             if method == "POST" and path.startswith("queuedResources"):
                 qr_id = re.search(r"queuedResourceId=([\w-]+)", url).group(1)
                 spec = body["tpu"]["nodeSpec"][0]
-                accel = spec["node"]["acceleratorType"]
+                node = spec["node"]
+                # API contract: acceleratorType XOR acceleratorConfig
+                assert ("acceleratorType" in node) != (
+                    "acceleratorConfig" in node)
+                accel = node.get("acceleratorType") or node[
+                    "acceleratorConfig"]["type"]
                 if accel in self.fail_accelerators:
                     return 400, {"error": f"no such accelerator {accel}"}
                 self.qrs[qr_id] = {
@@ -124,8 +129,11 @@ def test_create_list_terminate_slice():
     assert any("queuedResources?queuedResourceId=" in u
                for _m, u in svc.requests)
     qr = svc.qrs[pid]["body"]["tpu"]["nodeSpec"][0]["node"]
-    assert qr["acceleratorType"] == "v5litepod-4"
+    # topology requests carry acceleratorConfig ONLY (the API rejects
+    # both fields together)
+    assert "acceleratorType" not in qr
     assert qr["acceleratorConfig"]["topology"] == "2x2"
+    assert qr["acceleratorConfig"]["type"] == "V5LITE_POD"
     assert "guaranteed" in svc.qrs[pid]["body"]
 
     nodes = prov.non_terminated_nodes()
@@ -148,11 +156,44 @@ def test_spot_slices_request_spot_capacity():
 
 
 def test_create_failure_surfaces_api_error():
-    svc = MockTpuService(fail_accelerators={"v5litepod-4"})
+    svc = MockTpuService(fail_accelerators={"v5p-16"})
     prov = _provider(svc)
     with pytest.raises(GkeTpuError, match="no such accelerator"):
-        prov.create_node("tpu-v5e-4")
+        prov.create_node("tpu-v5p-16")
     assert prov.non_terminated_nodes() == {}
+
+
+def test_terminate_tolerates_externally_deleted_resources():
+    """A 404 on DELETE means the slice is already gone — terminated,
+    not an error (otherwise externally-reclaimed QRs retry forever)."""
+    svc = MockTpuService()
+    prov = _provider(svc)
+    (pid,) = prov.create_node("tpu-v5e-4")
+    del svc.qrs[pid]  # out-of-band cleanup
+    prov.terminate_node(pid)  # must not raise
+    assert pid not in prov._nodes
+
+
+def test_duplicate_create_after_retry_is_success():
+    """409 ALREADY_EXISTS on a retried create means the first attempt
+    landed — the slice must be tracked, not leaked."""
+    svc = MockTpuService()
+    calls = {"n": 0}
+
+    def flaky(method, url, body, headers):
+        status, payload = svc(method, url, body, headers)
+        if method == "POST" and calls["n"] == 0:
+            calls["n"] += 1
+            return 500, {"error": "backend blip"}  # QR already created
+        if method == "POST":
+            return 409, {"error": "alreadyExists"}
+        return status, payload
+
+    prov = GkeTpuNodeProvider(
+        _config(), project="p", zone="z",
+        transport=flaky, token_provider=lambda: "t")
+    (pid,) = prov.create_node("tpu-v5e-4")
+    assert pid in svc.qrs and pid in prov._nodes
 
 
 def test_direct_node_path_without_queued_resources():

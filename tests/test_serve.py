@@ -73,6 +73,35 @@ def test_http_proxy_roundtrip(serve_start):
     assert out == {"echo": {"hello": "world"}}
 
 
+def test_grpc_proxy_roundtrip(serve_start):
+    """gRPC ingress next to HTTP (reference: proxy.py:520 gRPCProxy):
+    the same route table served over a generic bytes unary API."""
+    import grpc
+
+    from ray_tpu.serve.grpc_proxy import HEALTHZ, LIST_APPS, channel_route
+
+    @serve.deployment(route_prefix="/gecho")
+    class GEcho:
+        def __call__(self, payload):
+            return {"gecho": payload}
+
+    serve.run(GEcho.bind(), _http=False, grpc_port=18652)
+    time.sleep(0.5)
+    addr = "127.0.0.1:18652"
+    # control surface
+    with grpc.insecure_channel(addr) as ch:
+        assert ch.unary_unary(HEALTHZ)(b"", timeout=30) == b"ok"
+        apps = json.loads(ch.unary_unary(LIST_APPS)(b"", timeout=30))
+        assert "GEcho" in apps
+    # data plane
+    out = channel_route(addr, "/gecho", {"hi": 5}, timeout=60)
+    assert out == {"gecho": {"hi": 5}}
+    # unknown application -> NOT_FOUND status
+    with pytest.raises(grpc.RpcError) as e:
+        channel_route(addr, "/nope", {}, timeout=30)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+
+
 def test_method_call_via_handle(serve_start):
     @serve.deployment
     class Calc:
