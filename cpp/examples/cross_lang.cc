@@ -19,12 +19,43 @@
 
 #include "ray_tpu/client.h"
 
+// Task-serving mode (reference: task_executor.cc): register native
+// functions and execute invocations Python pushes by descriptor.
+//   cross_lang <host> <port> --serve
+static int ServeMode(const char* host, int port) {
+  ray_tpu::TaskServer server;
+  server.Register("cpp_upper", [](const std::string& payload) {
+    std::string out = payload;
+    for (char& c : out)
+      if (c >= 'a' && c <= 'z') c = static_cast<char>(c - 'a' + 'A');
+    return out;
+  });
+  server.Register("cpp_add1", [](const std::string& payload) {
+    std::string out = payload;
+    for (char& c : out) c = static_cast<char>(c + 1);
+    return out;
+  });
+  server.Register("cpp_fail", [](const std::string&) -> std::string {
+    throw std::runtime_error("native failure for the test");
+  });
+  int bound = server.Listen("127.0.0.1", 0);
+  ray_tpu::ClientSession sess(host, port);
+  sess.RegisterCppWorker(server.FunctionNames(), "127.0.0.1", bound);
+  std::printf("CPP_SERVING %d\n", bound);
+  std::fflush(stdout);
+  server.ServeForever();
+  return 0;
+}
+
 int main(int argc, char** argv) {
   if (argc < 3) {
-    std::fprintf(stderr, "usage: %s host port [arena_path [lib_path]]\n",
+    std::fprintf(stderr,
+                 "usage: %s host port [--serve | arena_path [lib_path]]\n",
                  argv[0]);
     return 2;
   }
+  if (argc >= 4 && std::string(argv[3]) == "--serve")
+    return ServeMode(argv[1], std::atoi(argv[2]));
   ray_tpu::ClientSession sess(argv[1], std::atoi(argv[2]));
   std::printf("session: %s\n", sess.session_id().c_str());
 

@@ -21,9 +21,12 @@
 #include <unistd.h>
 
 #include <cstring>
+#include <functional>
+#include <map>
 #include <mutex>
 #include <stdexcept>
 #include <string>
+#include <thread>
 
 #include "pickle.h"
 
@@ -155,6 +158,18 @@ class ClientSession {
                      WithSession({{"api_method", Value(method)}}));
   }
 
+  // Announce a C++ task server: Python resolves these functions by
+  // descriptor (cross_language.cpp_function) and pushes invocations to
+  // host:port.
+  void RegisterCppWorker(const ValueList& function_names,
+                         const std::string& host, int port) {
+    ValueDict kw;
+    kw["functions"] = Value(function_names);
+    kw["host"] = Value(host);
+    kw["port"] = Value(static_cast<int64_t>(port));
+    rpc_.Call("client_register_cpp_worker", WithSession(std::move(kw)));
+  }
+
   const std::string& session_id() const { return session_id_; }
 
  private:
@@ -165,6 +180,137 @@ class ClientSession {
 
   RpcClient rpc_;
   std::string session_id_;
+};
+
+// ---------------------------------------------------------------------------
+// Task-serving mode: the C++ worker REGISTERS functions and executes
+// tasks Python pushes by descriptor.
+//
+// Reference: cpp/src/ray/runtime/task/task_executor.cc — the reference
+// C++ worker's executor loop receives pushed tasks and dispatches to
+// statically-registered functions (RAY_REMOTE). Here the server speaks
+// the framework's own (seq, method, kwargs) framing, so any cluster
+// process (including Python task executors resolving
+// cross_language.cpp_function descriptors) can push invocations with
+// the standard RpcClient pool.
+// ---------------------------------------------------------------------------
+class TaskServer {
+ public:
+  using Fn = std::function<std::string(const std::string&)>;
+
+  void Register(const std::string& name, Fn fn) {
+    fns_[name] = std::move(fn);
+  }
+
+  ValueList FunctionNames() const {
+    ValueList out;
+    for (const auto& [name, _fn] : fns_) out.push_back(Value(name));
+    return out;
+  }
+
+  // Bind + listen; returns the bound port (0 = ephemeral).
+  int Listen(const std::string& host = "127.0.0.1", int port = 0) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw RpcError("socket() failed");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw RpcError("bad address: " + host);
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      throw RpcError("bind() failed");
+    if (::listen(listen_fd_, 16) != 0) throw RpcError("listen() failed");
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    return ntohs(addr.sin_port);
+  }
+
+  // Accept loop; each connection is served on its own thread (Python
+  // keeps one pooled connection per process and pipelines frames).
+  // Runs until the process exits.
+  void ServeForever() {
+    for (;;) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) continue;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::thread([this, fd] { ServeConnection(fd); }).detach();
+    }
+  }
+
+ private:
+  void ServeConnection(int fd) {
+    try {
+      for (;;) {
+        char hdr[8];
+        if (!ReadAllFd(fd, hdr, 8)) break;
+        uint64_t n;
+        std::memcpy(&n, hdr, 8);
+        std::string data(n, '\0');
+        if (!ReadAllFd(fd, data.data(), n)) break;
+        Value frame = pickle::Decode(data);
+        const ValueList& tup = frame.as_list();  // (seq, method, kwargs)
+        int64_t seq = tup.at(0).as_int();
+        const std::string& method = tup.at(1).as_str();
+        std::string reply;
+        if (method == "ping") {
+          reply = pickle::EncodeReply(seq, 0, Value(true));
+        } else if (method == "invoke_cpp") {
+          const ValueDict& kw = tup.at(2).as_dict();
+          const std::string& fn_name = kw.at("fn").as_str();
+          auto it = fns_.find(fn_name);
+          if (it == fns_.end()) {
+            reply = pickle::EncodeReply(
+                seq, 1, Value("KeyError: no C++ function " + fn_name));
+          } else {
+            try {
+              std::string out = it->second(kw.at("payload").as_bytes());
+              reply = pickle::EncodeReply(seq, 0,
+                                          Value::Bytes(std::move(out)));
+            } catch (const std::exception& e) {
+              reply = pickle::EncodeReply(
+                  seq, 1, Value(std::string("RuntimeError: ") + e.what()));
+            }
+          }
+        } else {
+          reply = pickle::EncodeReply(seq, 1,
+                                      Value("no such method: " + method));
+        }
+        char rhdr[8];
+        uint64_t rn = reply.size();
+        std::memcpy(rhdr, &rn, 8);
+        if (!WriteAllFd(fd, rhdr, 8)) break;
+        if (!WriteAllFd(fd, reply.data(), reply.size())) break;
+      }
+    } catch (...) {
+    }
+    ::close(fd);
+  }
+
+  static bool ReadAllFd(int fd, char* p, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::read(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+  static bool WriteAllFd(int fd, const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::write(fd, p, n);
+      if (w <= 0) return false;
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+    return true;
+  }
+
+  std::map<std::string, Fn> fns_;
+  int listen_fd_ = -1;
 };
 
 }  // namespace ray_tpu

@@ -157,6 +157,19 @@ inline std::string EncodeCall(int64_t seq, const std::string& method,
   return out;
 }
 
+// Encodes a reply frame payload: the (seq, status, result) tuple the
+// Python RpcClient expects back (rpc.py _dispatch reply shape).
+inline std::string EncodeReply(int64_t seq, int64_t status,
+                               const Value& result) {
+  std::string out("\x80\x02", 2);  // PROTO 2
+  Encode(Value(seq), out);
+  Encode(Value(status), out);
+  Encode(result, out);
+  out += "\x87";  // TUPLE3
+  out.push_back('.');  // STOP
+  return out;
+}
+
 // ---------------------------------------------------------------- decode
 class Decoder {
  public:

@@ -473,6 +473,8 @@ class CoreWorker:
         self._actor_executor: Optional[ThreadPoolExecutor] = None
         self._group_executors: Dict[str, ThreadPoolExecutor] = {}
         self._group_semaphores: Dict[str, "asyncio.Semaphore"] = {}
+        # created lazily ON the loop (asyncio primitives bind their loop)
+        self._default_lane_lock: Optional["asyncio.Lock"] = None
         self._task_executor = ThreadPoolExecutor(
             max_workers=max(4, (os.cpu_count() or 4))
         )
@@ -848,6 +850,35 @@ class CoreWorker:
     def _alive_nodes(self) -> Dict[str, dict]:
         view = self.gcs.get_cluster_view()
         return {nid: v for nid, v in view.items() if v["alive"]}
+
+    def broadcast_object(self, ref: "ObjectRef",
+                         node_ids: Optional[Sequence[str]] = None,
+                         timeout: float = 300.0) -> int:
+        """Proactively replicate a shm object to other nodes via a
+        spanning-tree push (reference: push_manager.h — owner-side push
+        so an N-node broadcast doesn't N-fold the origin's egress).
+        Returns the number of target nodes. Inline (small) objects are
+        a no-op: their value already travels with the ref."""
+        oid = ref.id
+        if not self.store.contains(oid):
+            if self.memory_store.contains(oid):
+                return 0  # inline value: no shm copy to push
+            raise ObjectLostError(
+                f"{oid.hex()} has no local shm copy to broadcast from")
+        alive = self._alive_nodes()
+        targets = []
+        for nid, info in alive.items():
+            if nid == self.node_id:
+                continue
+            if node_ids is not None and nid not in node_ids:
+                continue
+            targets.append(list(info["address"]))
+        if not targets:
+            return 0
+        return int(self.raylet.call_sync(
+            "broadcast_object", object_id=oid.binary(), targets=targets,
+            timeout=timeout,
+        ))
 
     def _get_borrowed(self, ref: ObjectRef, deadline):
         """Object owned by another process: ask the owner."""
@@ -2475,14 +2506,15 @@ class CoreWorker:
                 serialize = (self._max_concurrency == 1 and not is_async
                              and not spec.get("concurrency_group"))
                 if serialize:
-                    # full execution serialization in seq order
-                    try:
-                        reply = await self._run_actor_method(spec)
-                        if not fut.done():
-                            fut.set_result(reply)
-                    except Exception as e:  # noqa: BLE001
-                        if not fut.done():
-                            fut.set_exception(e)
+                    # default-lane serialization WITHOUT blocking this
+                    # drain loop: executions chain through a FIFO lane
+                    # lock (dispatch order = seq order = wake order),
+                    # so a long default method can't starve group-lane
+                    # calls queued behind it
+                    if self._default_lane_lock is None:
+                        self._default_lane_lock = asyncio.Lock()
+                    asyncio.ensure_future(
+                        self._run_serialized(spec, fut))
                 else:
                     # ordered dispatch, concurrent execution
                     asyncio.ensure_future(
@@ -2505,6 +2537,13 @@ class CoreWorker:
         except Exception as e:  # noqa: BLE001
             if not fut.done():
                 fut.set_exception(e)
+
+    async def _run_serialized(self, spec: dict, fut: asyncio.Future):
+        """Default-lane execution: one at a time, FIFO (asyncio.Lock
+        wakes waiters in acquisition order, which is dispatch = seq
+        order)."""
+        async with self._default_lane_lock:
+            await self._run_and_resolve(spec, fut)
 
     async def _run_actor_method(self, spec: dict):
         loop = asyncio.get_running_loop()

@@ -116,6 +116,9 @@ class GcsServer:
         self._mut_seq = 0
         self._persisted_seq = 0
         self._persist_writing: Optional[asyncio.Task] = None
+        import threading as _threading
+
+        self._snapshot_write_lock = _threading.Lock()
         if persist_path and os.path.exists(persist_path):
             self._load_snapshot(persist_path)
 
@@ -218,15 +221,18 @@ class GcsServer:
             return None
 
     def _write_snapshot(self, data: bytes) -> bool:
-        try:
-            tmp = self._persist_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, self._persist_path)
-            return True
-        except Exception as e:  # noqa: BLE001
-            print(f"[gcs] snapshot write failed: {e}", flush=True)
-            return False
+        # the threading lock covers the shutdown-path _persist_now
+        # racing an in-flight executor write (same .tmp inode)
+        with self._snapshot_write_lock:
+            try:
+                tmp = self._persist_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, self._persist_path)
+                return True
+            except Exception as e:  # noqa: BLE001
+                print(f"[gcs] snapshot write failed: {e}", flush=True)
+                return False
 
     def _persist_now(self):
         """Synchronous snapshot (shutdown path)."""
@@ -235,17 +241,23 @@ class GcsServer:
             self._write_snapshot(data)
 
     async def _persist_async(self):
-        data = self._snapshot_bytes()
-        if data is not None:
-            await asyncio.get_running_loop().run_in_executor(
-                None, self._write_snapshot, data
-            )
+        """All snapshot writes funnel through the single-flight
+        _persist_covering writer: two concurrent writers on the same
+        .tmp path would interleave two pickles into one torn file, and
+        crediting _persisted_seq here lets _persist_critical skip a
+        duplicate write the debounce loop already covered."""
+        if self._persist_writing is None or self._persist_writing.done():
+            self._persist_writing = asyncio.ensure_future(
+                self._persist_covering())
+        try:
+            await asyncio.shield(self._persist_writing)
+        except Exception:  # noqa: BLE001 — logged in _write_snapshot
+            pass
 
     async def _persist_loop(self):
         """Debounced atomic snapshots: coalesces bursts, loses at most
-        ~50ms of mutations on kill -9 (the Redis-backed reference is
-        per-mutation durable; this is the documented tradeoff of the
-        file backend)."""
+        ~50ms of non-critical mutations on kill -9 (registrations are
+        separately durable via _persist_critical)."""
         while True:
             await self._dirty.wait()
             await asyncio.sleep(0.05)

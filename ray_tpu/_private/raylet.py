@@ -210,6 +210,7 @@ class Raylet:
         os.makedirs(self.spill_dir, exist_ok=True)
         self._spilled: Dict[bytes, str] = {}  # object_id bytes -> path
         self._inflight_pulls: Dict[bytes, asyncio.Future] = {}
+        self._object_egress: Dict[bytes, int] = {}
 
         # worker pool — split by accelerator access: TPU chips are
         # process-exclusive (libtpu single-owner; reference handles this
@@ -1256,6 +1257,60 @@ class Raylet:
         self.store.seal(oid)
         return True
 
+    # --- push-based transfer (reference: push_manager.h:27) ------------
+    async def push_object(self, object_id: bytes, from_address: List[Any],
+                          subtree: List[Any] = ()) -> int:
+        """Receive a pushed object: pull it from ``from_address`` then
+        forward it down this node's subtree. Spanning-tree broadcast —
+        each copy becomes a source for ~2 more nodes, so an N-node
+        broadcast costs the ORIGIN ~2 transfers of egress instead of N
+        (reference: PushManager; BASELINE 1 GiB x 50-node broadcast).
+        Returns the number of nodes (including this one) that received
+        a copy."""
+        ok = await self.pull_object(object_id, from_address)
+        if not ok:
+            return 0
+        return 1 + await self._fanout_object(object_id, list(subtree))
+
+    async def broadcast_object(self, object_id: bytes,
+                               targets: List[Any]) -> int:
+        """Broadcast a locally-present object to ``targets`` (list of
+        raylet addresses) via a binary spanning tree rooted here.
+        Returns the number of targets confirmed delivered."""
+        if not self.store.contains(ObjectID(object_id)):
+            return 0
+        return await self._fanout_object(object_id, list(targets))
+
+    async def _fanout_object(self, object_id: bytes,
+                             targets: List[Any]) -> int:
+        if not targets:
+            return 0
+        # split into two subtrees, each headed by its first node; the
+        # heads pull from HERE and forward the rest concurrently
+        halves = [targets[: (len(targets) + 1) // 2],
+                  targets[(len(targets) + 1) // 2:]]
+
+        async def send(half) -> int:
+            head, rest = half[0], half[1:]
+            peer = self._pool.get(head[0], int(head[1]))
+            try:
+                n = await peer.call(
+                    "push_object", object_id=object_id,
+                    from_address=list(self.address), subtree=rest,
+                    timeout=300.0,
+                )
+                if n:
+                    return int(n)
+            except Exception:
+                pass
+            # the head failed (unreachable, or its pull returned 0):
+            # its whole subtree would be orphaned — re-fan the
+            # remainder from here (degraded but correct)
+            return await self._fanout_object(object_id, rest)
+
+        counts = await asyncio.gather(*[send(h) for h in halves if h])
+        return sum(counts)
+
     async def object_info(self, object_id: bytes):
         oid = ObjectID(object_id)
         buf = self.store.get_buffer(oid)
@@ -1268,7 +1323,22 @@ class Raylet:
         size = buf.nbytes
         buf.release()
         self.store.release(oid)
+        # every remote pull starts with object_info: this counts this
+        # node's per-object egress (observable in tests/benches — the
+        # broadcast tree keeps the origin's count at ~2, not N).
+        # Bounded: oldest entries drop past 4096 (diagnostic data,
+        # must not grow with the node's lifetime object churn)
+        self._object_egress[object_id] = (
+            self._object_egress.get(object_id, 0) + 1)
+        while len(self._object_egress) > 4096:
+            self._object_egress.pop(next(iter(self._object_egress)))
         return {"size": size}
+
+    async def object_egress_count(self, object_id: bytes) -> int:
+        return self._object_egress.get(object_id, 0)
+
+    async def has_object(self, object_id: bytes) -> bool:
+        return self.store.contains(ObjectID(object_id))
 
     async def read_object_chunk(self, object_id: bytes, offset: int,
                                 nbytes: int):
@@ -1284,6 +1354,7 @@ class Raylet:
 
     async def delete_objects(self, object_ids: List[bytes]):
         for ob in object_ids:
+            self._object_egress.pop(ob, None)
             try:
                 self.store.delete(ObjectID(ob))
             except Exception:

@@ -35,3 +35,76 @@ def get_function(name: str) -> Callable[[bytes], bytes]:
     if blob is None:
         raise KeyError(f"no cross-language function registered as {name!r}")
     return cloudpickle.loads(blob)
+
+
+# ---------------------------------------------------------------------------
+# the reverse direction: Python -> C++ by descriptor
+# (reference: cpp/src/ray/runtime/task/task_executor.cc — C++ workers
+# register functions and execute pushed tasks; python/ray/cross_language
+# .py cpp_function builds the descriptor-call)
+# ---------------------------------------------------------------------------
+_CPP_NS = "cpp_workers"
+
+
+def register_cpp_worker(functions, host: str, port: int) -> None:
+    """Record a C++ task server's address under each function it
+    serves. Called by the client server when a native worker announces
+    itself (client_register_cpp_worker)."""
+    from ._private.core_worker import global_worker
+
+    w = global_worker()
+    for name in functions:
+        w.gcs.kv_put(ns=_CPP_NS, key=str(name),
+                     value=f"{host}:{port}".encode())
+
+
+def invoke_cpp_local(name: str, payload: bytes,
+                     timeout: float = 60.0) -> bytes:
+    """Execute one C++ function invocation from THIS process: resolve
+    the serving worker's address from the registry and push the task
+    over the framework's RPC framing (the C++ TaskServer speaks the
+    same (seq, method, kwargs) protocol as every other peer)."""
+    from ._private.core_worker import global_worker
+
+    w = global_worker()
+    addr = w.gcs.kv_get(ns=_CPP_NS, key=name)
+    if addr is None:
+        raise KeyError(f"no C++ worker serves function {name!r}")
+    host, port = addr.decode().rsplit(":", 1)
+    cli = w._pool.get(host, int(port))
+    out = cli.call_sync("invoke_cpp", fn=name, payload=bytes(payload),
+                        timeout=timeout)
+    return bytes(out)
+
+
+_cpp_invoke_task = None
+
+
+def cpp_function(name: str):
+    """A handle to a C++-executed function: ``cpp_function("f").remote(
+    payload) -> ObjectRef[bytes]``. The invocation rides a normal task
+    (scheduling, retries, ownership) whose executor pushes the payload
+    to the registered C++ task server and returns its bytes reply."""
+    global _cpp_invoke_task
+    if _cpp_invoke_task is None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _call_cpp(fn_name: str, payload: bytes) -> bytes:
+            from ray_tpu.cross_language import invoke_cpp_local
+
+            return invoke_cpp_local(fn_name, payload)
+
+        _cpp_invoke_task = _call_cpp
+
+    class _CppFunction:
+        def __init__(self, fn_name):
+            self._name = fn_name
+
+        def remote(self, payload: bytes):
+            return _cpp_invoke_task.remote(self._name, bytes(payload))
+
+        def __repr__(self):
+            return f"CppFunction({self._name!r})"
+
+    return _CppFunction(name)

@@ -1,8 +1,22 @@
-"""ray_tpu.experimental — device-resident objects (RDT analogue).
+"""ray_tpu.experimental — device-resident objects (RDT analogue) and
+proactive object broadcast.
 
-Reference: python/ray/experimental/gpu_object_manager/.
+Reference: python/ray/experimental/gpu_object_manager/ and
+src/ray/object_manager/push_manager.h.
 """
 from .device_objects import (  # noqa: F401
     DeviceObjectMeta,
     DeviceObjectStore,
 )
+
+
+def broadcast_object(ref, node_ids=None, timeout: float = 300.0) -> int:
+    """Replicate ``ref``'s shm object to every (or the given) alive
+    node via a spanning-tree push: the origin sends ~2 copies and each
+    recipient forwards to its subtree (reference: PushManager — the
+    50-node 1 GiB broadcast must not 50x the owner's egress). Returns
+    the number of nodes pushed to. Subsequent ray.get on those nodes is
+    a local zero-copy read."""
+    from .._private.core_worker import global_worker
+
+    return global_worker().broadcast_object(ref, node_ids, timeout)

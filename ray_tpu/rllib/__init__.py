@@ -20,11 +20,16 @@ from .env_runner import EnvRunner
 from .learner import Learner, LearnerGroup
 from .config import AlgorithmConfig
 from .algorithm import Algorithm
-from .algorithms import PPO, PPOConfig, DQN, DQNConfig, SAC, SACConfig
+from .algorithms import (PPO, PPOConfig, DQN, DQNConfig, SAC,
+                         SACConfig, IMPALA, IMPALAConfig)
+from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                          MultiAgentPPO, IndependentCartPoles)
 
 __all__ = [
     "Box", "Discrete", "Env", "VectorEnv", "register_env", "make_env",
     "SampleBatch", "ActorCriticModule", "QModule", "EnvRunner",
     "Learner", "LearnerGroup", "AlgorithmConfig", "Algorithm",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+    "IMPALA", "IMPALAConfig", "MultiAgentEnv", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "IndependentCartPoles",
 ]

@@ -1,5 +1,7 @@
 from .ppo import PPO, PPOConfig
 from .dqn import DQN, DQNConfig
 from .sac import SAC, SACConfig
+from .impala import IMPALA, IMPALAConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+           "IMPALA", "IMPALAConfig"]

@@ -1,0 +1,220 @@
+"""IMPALA: asynchronous actor-learner training with V-trace.
+
+Reference: rllib/algorithms/impala/impala.py:1 — env runners sample
+continuously WITHOUT blocking on the learner; the learner consumes
+batches as they arrive, so rollouts are produced by slightly stale
+("behavior") policies and the loss corrects for the off-policy gap with
+V-trace importance weighting (Espeholt et al. 2018).
+
+TPU-first: the whole V-trace recursion + policy/value update is one
+jitted XLA program (lax.scan over the time axis for the vs targets);
+the async plumbing is ray_tpu futures — in-flight sample() calls on
+every runner, drained with ray.wait as they complete, with weights
+pushed back to each runner only after it delivers (so a slow runner
+never stalls the learner and a fast learner never stalls sampling).
+"""
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithm import Algorithm
+from ..config import AlgorithmConfig
+from ..env import make_env
+from ..learner import Learner
+from ..rl_module import ActorCriticModule
+from ..sample_batch import (
+    ACTIONS, DONES, LOGP, OBS, REWARDS, SampleBatch,
+)
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 6e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        # V-trace truncation thresholds (paper defaults)
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        # max batches consumed per train() call (an iteration boundary
+        # for metrics; the async pipeline keeps flowing between calls)
+        self.max_batches_per_iteration = 4
+        # default to async fan-out: IMPALA with 0 runners degrades to
+        # a synchronous loop (still V-trace corrected)
+        self.num_env_runners = 2
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+def _vtrace(behavior_logp, target_logp, rewards, values, dones,
+            last_value, gamma, clip_rho, clip_c):
+    """[T, B] inputs -> (vs targets, policy-gradient advantages).
+
+    vs_t = V_t + sum_k gamma^{k-t} (prod c) delta_k computed as a
+    reverse scan; pg_adv_t = rho_t (r_t + gamma vs_{t+1} - V_t)."""
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_rho)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), clip_c)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    # values_{t+1}: shift with the bootstrap value at the end
+    values_tp1 = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = rho * (rewards + gamma * not_done * values_tp1 - values)
+
+    def step(acc, xs):
+        delta_t, c_t, nd_t = xs
+        acc = delta_t + gamma * nd_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(last_value), (deltas, c, not_done),
+        reverse=True)
+    vs = vs_minus_v + values
+    vs_tp1 = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * not_done * vs_tp1 - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALALearner(Learner):
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        self._update_jit = jax.jit(partial(
+            self._update_impl,
+            gamma=config.get("gamma", 0.99),
+            clip_rho=config.get("vtrace_clip_rho", 1.0),
+            clip_c=config.get("vtrace_clip_c", 1.0),
+            vf_coeff=config.get("vf_loss_coeff", 0.5),
+            ent_coeff=config.get("entropy_coeff", 0.01),
+        ))
+
+    def _update_impl(self, params, opt_state, batch, *, gamma, clip_rho,
+                     clip_c, vf_coeff, ent_coeff):
+        T, B = batch[REWARDS].shape
+        obs_flat = batch[OBS].reshape(T * B, -1)
+        acts_flat = batch[ACTIONS].reshape(
+            (T * B,) + batch[ACTIONS].shape[2:])
+
+        def loss_fn(p):
+            # current-policy logp/values on the behavior trajectories
+            logp = self.module.logp(p, obs_flat, acts_flat).reshape(T, B)
+            values = self.module.value(p, obs_flat).reshape(T, B)
+            last_value = self.module.value(p, batch["last_obs"])
+            vs, pg_adv = _vtrace(
+                batch[LOGP], logp, batch[REWARDS], values,
+                batch[DONES], last_value, gamma, clip_rho, clip_c)
+            pg_loss = -(logp * pg_adv).mean()
+            vf_loss = 0.5 * ((values - vs) ** 2).mean()
+            ent = self.module.entropy(p, obs_flat).mean()
+            loss = pg_loss + vf_coeff * vf_loss - ent_coeff * ent
+            return loss, (pg_loss, vf_loss, ent,
+                          jnp.exp(batch[LOGP] - logp).mean())
+
+        import optax
+
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        pg_loss, vf_loss, ent, is_ratio = aux
+        return params, opt_state, {
+            "total_loss": loss,
+            "pg_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": ent,
+            "mean_is_ratio": is_ratio,  # ~1 when nearly on-policy
+        }
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        T, B = (int(x) for x in batch["t_b_shape"][:2])
+        dev_batch = {
+            OBS: jnp.asarray(batch[OBS]).reshape(T, B, -1),
+            ACTIONS: jnp.asarray(batch[ACTIONS]).reshape(
+                (T, B) + np.asarray(batch[ACTIONS]).shape[1:]),
+            LOGP: jnp.asarray(batch[LOGP]).reshape(T, B),
+            REWARDS: jnp.asarray(batch[REWARDS]).reshape(T, B),
+            DONES: jnp.asarray(batch[DONES]).reshape(T, B),
+            "last_obs": jnp.asarray(batch["next_obs"][-B:]),
+        }
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state, dev_batch)
+        self._metrics = {k: float(v) for k, v in metrics.items()}
+        return dict(self._metrics)
+
+
+class IMPALA(Algorithm):
+    learner_cls = IMPALALearner
+
+    def __init__(self, config: AlgorithmConfig):
+        super().__init__(config)
+        # runner -> in-flight sample future (the async pipeline)
+        self._inflight: Dict = {}
+
+    def _build_module(self):
+        probe = make_env(self.config.env, **self.config.env_config)
+        return ActorCriticModule(
+            probe.observation_space, probe.action_space,
+            hiddens=self.config.hiddens)
+
+    def train(self) -> Dict:
+        """Async iteration: drain arriving rollout batches, update per
+        batch (V-trace absorbs the staleness), refresh ONLY the
+        delivering runner's weights, relaunch its next sample — the
+        learner and every runner stay busy simultaneously (reference:
+        impala.py's aggregated async queue)."""
+        if not self._remote:
+            return super().train()  # degenerate sync fallback
+
+        import ray_tpu as ray
+
+        t0 = time.monotonic()
+        frag = self.config.rollout_fragment_length
+        for r in self._runners:
+            if r not in self._inflight.values():
+                self._inflight[r.sample.remote(frag)] = r
+
+        consumed = 0
+        learn: Dict = {}
+        max_b = self.config.max_batches_per_iteration
+        while consumed < max_b:
+            ready, _pending = ray.wait(
+                list(self._inflight), num_returns=1, timeout=60.0)
+            if not ready:
+                break
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            batch = ray.get(ref)
+            learn = self.learner_group.update(batch)
+            self._total_steps += batch.count
+            consumed += 1
+            # push fresh weights to THIS runner only, then put it back
+            # to work — no global barrier
+            w = self.learner_group.get_weights()
+            runner.set_weights.remote(w, None)
+            self._inflight[runner.sample.remote(frag)] = runner
+
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._total_steps,
+            "num_batches_consumed": consumed,
+            "time_this_iter_s": time.monotonic() - t0,
+            **self._episode_stats(),
+            **{f"learner/{k}": v for k, v in learn.items()},
+        }
+
+    def training_step_from_rollouts(self, batches) -> Dict:
+        out = {}
+        for b in batches:
+            out = self.training_step(b)
+        return out
+
+    def stop(self):
+        self._inflight.clear()
+        super().stop()

@@ -1,0 +1,268 @@
+"""Multi-agent environments and per-policy training.
+
+Reference: rllib/env/multi_agent_env.py (MultiAgentEnv — dict-keyed
+obs/action/reward per agent, "__all__" termination) +
+rllib/env/multi_agent_env_runner.py:1 (per-policy batch collection via
+policy_mapping_fn) + the multi-agent Algorithm surface (one RLModule /
+Learner per policy id).
+
+TPU-first shape: agents with the SAME policy step as one batched
+forward — the runner groups agent rows per policy and calls each
+policy's jitted sampler once per step, so an N-agent environment costs
+num_policies dispatches, not N.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .env import CartPole, VectorEnv
+from .sample_batch import (
+    ACTIONS, DONES, LOGP, NEXT_OBS, OBS, REWARDS, SampleBatch, VALUES,
+)
+
+AgentID = str
+PolicyID = str
+
+
+class MultiAgentEnv:
+    """Dict-keyed multi-agent API (reference: multi_agent_env.py).
+
+    reset() -> {agent_id: obs}
+    step({agent_id: action}) ->
+        (obs_dict, reward_dict, terminated_dict, truncated_dict, infos)
+    terminated_dict carries the "__all__" key ending the episode.
+    """
+
+    agents: List[AgentID] = []
+
+    def reset(self, seed: Optional[int] = None) -> Dict[AgentID, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[AgentID, np.ndarray]):
+        raise NotImplementedError
+
+
+class IndependentCartPoles(MultiAgentEnv):
+    """N agents, each balancing its own cart (auto-reset per agent —
+    the env itself never emits "__all__" except on explicit horizon).
+    Internally ONE batched CartPole vector env: the whole multi-agent
+    step is a single numpy ufunc pass."""
+
+    def __init__(self, n_agents: int = 2, seed: int = 0):
+        self.agents = [f"agent_{i}" for i in range(n_agents)]
+        self._vec = VectorEnv(CartPole, n_agents, seed=seed)
+        self.observation_space = self._vec.observation_space
+        self.action_space = self._vec.action_space
+
+    def reset(self, seed: Optional[int] = None):
+        obs = self._vec.reset(seed=seed)
+        return {a: obs[i] for i, a in enumerate(self.agents)}
+
+    def step(self, action_dict):
+        acts = np.asarray([action_dict[a] for a in self.agents])
+        obs, rew, done = self._vec.step(acts)
+        obs_d = {a: obs[i] for i, a in enumerate(self.agents)}
+        rew_d = {a: float(rew[i]) for i, a in enumerate(self.agents)}
+        term_d = {a: bool(done[i]) for i, a in enumerate(self.agents)}
+        term_d["__all__"] = False  # per-agent auto-reset, endless stream
+        return obs_d, rew_d, term_d, {}, {}
+
+    def pop_episode_stats(self):
+        return self._vec.pop_episode_stats()
+
+
+class MultiAgentEnvRunner:
+    """Rollout worker for MultiAgentEnv: collects per-POLICY batches
+    (reference: multi_agent_env_runner.py builds MultiAgentEpisodes and
+    splits them per module id). Same-policy agents batch into one
+    jitted forward per step."""
+
+    def __init__(self, env_creator: Callable[[], MultiAgentEnv],
+                 policy_mapping_fn: Callable[[AgentID], PolicyID],
+                 seed: int = 0):
+        self.env = env_creator()
+        self.policy_mapping_fn = policy_mapping_fn
+        self._modules: Dict[PolicyID, object] = {}
+        self._params: Dict[PolicyID, object] = {}
+        self._sample_fns: Dict[PolicyID, object] = {}
+        self._key = jax.random.PRNGKey(seed)
+        self._obs = self.env.reset(seed=seed)
+        # fixed agent->policy grouping (agent sets are static here;
+        # dynamic agent populations would regroup per step)
+        self._groups: Dict[PolicyID, List[AgentID]] = {}
+        for a in self.env.agents:
+            self._groups.setdefault(policy_mapping_fn(a), []).append(a)
+
+    def set_modules(self, modules: Dict[PolicyID, object]) -> bool:
+        self._modules = dict(modules)
+        self._sample_fns = {
+            pid: jax.jit(m.sample_action)
+            for pid, m in modules.items()
+        }
+        return True
+
+    def set_weights(self, weights: Dict[PolicyID, object],
+                    epsilon=None) -> bool:
+        for pid, w in weights.items():
+            self._params[pid] = jax.device_put(w)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[PolicyID, SampleBatch]:
+        """num_steps env steps -> one [T, n_agents_of_policy] batch per
+        policy (trajectory structure preserved for GAE)."""
+        cols: Dict[PolicyID, Dict[str, list]] = {
+            pid: {OBS: [], ACTIONS: [], REWARDS: [], DONES: [],
+                  NEXT_OBS: [], LOGP: [], VALUES: []}
+            for pid in self._groups
+        }
+        for _ in range(num_steps):
+            action_dict = {}
+            step_rows: Dict[PolicyID, np.ndarray] = {}
+            for pid, agents in self._groups.items():
+                obs_rows = np.stack([self._obs[a] for a in agents])
+                self._key, sub = jax.random.split(self._key)
+                act, logp, value = self._sample_fns[pid](
+                    self._params[pid], obs_rows, sub)
+                act = np.asarray(act)
+                for i, a in enumerate(agents):
+                    action_dict[a] = act[i]
+                step_rows[pid] = (obs_rows, act, np.asarray(logp),
+                                  np.asarray(value))
+            next_obs, rew, term, _trunc, _info = self.env.step(
+                action_dict)
+            for pid, agents in self._groups.items():
+                obs_rows, act, logp, value = step_rows[pid]
+                c = cols[pid]
+                c[OBS].append(obs_rows)
+                c[ACTIONS].append(act)
+                c[LOGP].append(logp)
+                c[VALUES].append(value)
+                c[REWARDS].append(
+                    np.asarray([rew[a] for a in agents], np.float32))
+                c[DONES].append(
+                    np.asarray([term[a] for a in agents]))
+                c[NEXT_OBS].append(
+                    np.stack([next_obs[a] for a in agents]))
+            self._obs = next_obs
+        out = {}
+        for pid, c in cols.items():
+            n_agents = len(self._groups[pid])
+            sb = SampleBatch({
+                k: np.stack(v).reshape(
+                    (-1,) + np.asarray(v[0]).shape[1:])
+                for k, v in c.items()
+            })
+            sb["t_b_shape"] = np.asarray([num_steps, n_agents])
+            out[pid] = sb
+        return out
+
+    def episode_stats(self):
+        if hasattr(self.env, "pop_episode_stats"):
+            rets, lens = self.env.pop_episode_stats()
+            return {"episode_returns": rets, "episode_lengths": lens}
+        return {"episode_returns": [], "episode_lengths": []}
+
+
+class MultiAgentPPO:
+    """Per-policy PPO: one ActorCriticModule + PPOLearner per policy
+    id, trained on that policy's own batches (reference: the
+    multi-agent Algorithm path — per-module losses through the same
+    Learner machinery)."""
+
+    def __init__(self, env_creator: Callable[[], MultiAgentEnv],
+                 policies: List[PolicyID],
+                 policy_mapping_fn: Callable[[AgentID], PolicyID],
+                 *, rollout_fragment_length: int = 64,
+                 num_env_runners: int = 0, seed: int = 0,
+                 learner_config: Optional[dict] = None):
+        from .algorithms.ppo import PPOLearner
+        from .rl_module import ActorCriticModule
+
+        probe = env_creator()
+        cfg = {"num_epochs": 6, "minibatch_size": 64, "lr": 3e-4,
+               **(learner_config or {})}
+        self.policies = list(policies)
+        self.modules = {
+            pid: ActorCriticModule(probe.observation_space,
+                                   probe.action_space)
+            for pid in policies
+        }
+        self.learners = {
+            pid: PPOLearner(self.modules[pid], cfg, seed=seed + i)
+            for i, pid in enumerate(policies)
+        }
+        self.rollout_fragment_length = rollout_fragment_length
+        if num_env_runners == 0:
+            self._runners = [MultiAgentEnvRunner(
+                env_creator, policy_mapping_fn, seed=seed)]
+            self._remote = False
+            self._runners[0].set_modules(self.modules)
+        else:
+            import ray_tpu as ray
+
+            cls = ray.remote(MultiAgentEnvRunner)
+            self._runners = [
+                cls.remote(env_creator, policy_mapping_fn, seed=seed + i)
+                for i in range(num_env_runners)
+            ]
+            self._remote = True
+            ray.get([r.set_modules.remote(self.modules)
+                     for r in self._runners])
+        self.iteration = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        w = {pid: ln.get_weights() for pid, ln in self.learners.items()}
+        if self._remote:
+            import ray_tpu as ray
+
+            ray.get([r.set_weights.remote(w) for r in self._runners])
+        else:
+            self._runners[0].set_weights(w)
+
+    def train(self) -> Dict:
+        t0 = time.monotonic()
+        self._sync_weights()
+        if self._remote:
+            import ray_tpu as ray
+
+            all_batches = ray.get([
+                r.sample.remote(self.rollout_fragment_length)
+                for r in self._runners
+            ])
+            stats = ray.get([r.episode_stats.remote()
+                             for r in self._runners])
+        else:
+            all_batches = [
+                self._runners[0].sample(self.rollout_fragment_length)]
+            stats = [self._runners[0].episode_stats()]
+        learn: Dict[str, float] = {}
+        for pid in self.policies:
+            for batches in all_batches:
+                if pid in batches:
+                    m = self.learners[pid].update(batches[pid])
+                    learn.update(
+                        {f"{pid}/{k}": v for k, v in m.items()})
+        rets = [r for s in stats for r in s["episode_returns"]]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(rets)) if rets else float("nan")),
+            "time_this_iter_s": time.monotonic() - t0,
+            **learn,
+        }
+
+    def stop(self):
+        if self._remote:
+            import ray_tpu as ray
+
+            for r in self._runners:
+                try:
+                    ray.kill(r)
+                except Exception:
+                    pass

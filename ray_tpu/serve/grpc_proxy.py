@@ -83,6 +83,8 @@ class GrpcProxyActor:
             return b""
         app = body.get("application", "")
         target = self._routes.get(app)
+        if target is None and app in self._routes.values():
+            target = app  # deployment name (what ListApplications shows)
         if target is None:
             # fall back to longest-prefix match like the HTTP proxy
             longest = -1

@@ -393,6 +393,21 @@ class ClientServer:
         ref = await self._in_thread(fn.remote, payload)
         return self._track(sess, ref)[0]
 
+    async def client_register_cpp_worker(self, session_id: str,
+                                         functions: list, host: str,
+                                         port: int) -> bool:
+        """A native (C++) worker announces the functions it serves.
+        Python invokes them by descriptor via
+        cross_language.cpp_function (reference: the reverse direction of
+        client_task_by_name; cpp/src/ray/runtime/task/task_executor.cc
+        registers C++ functions for by-descriptor execution)."""
+        from ...cross_language import register_cpp_worker
+
+        self._session(session_id)
+        await self._in_thread(
+            register_cpp_worker, list(functions), str(host), int(port))
+        return True
+
     async def client_api(self, session_id: str, api_method: str) -> Any:
         """Read-only cluster info passthrough."""
         import ray_tpu as ray

@@ -1,0 +1,77 @@
+"""Push-based object broadcast (reference: push_manager.h:27).
+
+VERDICT 'done' bar: 1 object -> 8 nodes with <= 2 pulls of owner
+egress (the spanning tree makes every copy a source for ~2 more)."""
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+import ray_tpu.api as api
+from ray_tpu import experimental
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_node_args={"resources": {"CPU": 2}})
+    for _ in range(8):
+        c.add_node(resources={"CPU": 1})
+    ray.init(address=c.address)
+    yield c
+    ray.shutdown()
+    c.shutdown()
+
+
+def test_broadcast_tree_limits_owner_egress(cluster):
+    w = api.global_worker()
+    payload = np.arange(512 * 1024, dtype=np.int64)  # 4 MiB: shm path
+    ref = ray.put(payload)
+    assert w.store.contains(ref.id), "payload unexpectedly inline"
+
+    n = experimental.broadcast_object(ref, timeout=300)
+    assert n == 8
+
+    # every node now holds a sealed copy...
+    alive = w._alive_nodes()
+    missing = []
+    for nid, info in alive.items():
+        if nid == w.node_id:
+            continue
+        peer = w._pool.get(*info["address"])
+        if not peer.call_sync("has_object", object_id=ref.id.binary(),
+                              timeout=30):
+            missing.append(nid)
+    assert not missing, f"nodes without a copy: {missing}"
+
+    # ...and the ORIGIN served at most 2 of the 8 transfers
+    egress = w.raylet.call_sync(
+        "object_egress_count", object_id=ref.id.binary(), timeout=30)
+    assert egress <= 2, f"owner egress {egress} > 2 (not a push tree)"
+
+
+def test_broadcast_then_remote_reads_are_local(cluster):
+    w = api.global_worker()
+    payload = np.ones(256 * 1024, dtype=np.float64)  # 2 MiB
+    ref = ray.put(payload)
+    experimental.broadcast_object(ref, timeout=300)
+
+    @ray.remote
+    def consume(x):
+        return float(x.sum())
+
+    # tasks across the cluster read the broadcast copy (correctness:
+    # every node returns the same sum; SPREAD places them broadly)
+    outs = ray.get([
+        consume.options(scheduling_strategy="SPREAD").remote(ref)
+        for _ in range(8)
+    ], timeout=300)
+    assert all(o == pytest.approx(256 * 1024) for o in outs)
+    # owner egress stays bounded even with 8 remote consumers
+    egress = w.raylet.call_sync(
+        "object_egress_count", object_id=ref.id.binary(), timeout=30)
+    assert egress <= 2
+
+
+def test_broadcast_inline_object_is_noop(cluster):
+    ref = ray.put(42)  # tiny: memory-store inline
+    assert experimental.broadcast_object(ref) == 0

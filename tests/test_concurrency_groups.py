@@ -66,6 +66,20 @@ def test_groups_do_not_block_each_other(ray_start):
     assert ray.get(blocker, timeout=60) == "compute"
 
 
+def test_group_flows_past_blocked_default_lane(ray_start):
+    """The reverse direction: a long serialized default-lane method
+    must not hold up group-lane calls dispatched after it."""
+    a = Grouped.remote()
+    ray.get(a.io_sleep.remote(0.0), timeout=60)
+    blocker = a.default_sleep.remote(1.5)
+    t0 = time.perf_counter()
+    assert ray.get(a.io_sleep.remote(0.05), timeout=60) == "io"
+    io_latency = time.perf_counter() - t0
+    assert io_latency < 1.0, (
+        f"io lane stuck behind default lane: {io_latency}")
+    assert ray.get(blocker, timeout=60) == "default"
+
+
 def test_call_time_group_override(ray_start):
     a = Grouped.remote()
     ray.get(a.io_sleep.remote(0.0), timeout=60)

@@ -1,0 +1,123 @@
+"""IMPALA (async + V-trace) and multi-agent learning-curve tests.
+
+Reference: rllib/algorithms/impala/impala.py:1 and
+rllib/env/multi_agent_env_runner.py:1.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.rllib import IMPALAConfig, IndependentCartPoles, MultiAgentPPO
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+def test_vtrace_matches_gae_on_policy():
+    """With behavior == target policy (ratios 1) and c=rho=1, V-trace
+    targets reduce to the lambda=1 GAE targets."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.algorithms.impala import _vtrace
+
+    rng = np.random.default_rng(0)
+    T, B = 12, 3
+    rewards = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    dones = jnp.zeros((T, B))
+    last_value = jnp.asarray(rng.normal(size=(B,)).astype(np.float32))
+    logp = jnp.asarray(rng.normal(size=(T, B)).astype(np.float32))
+    gamma = 0.9
+    vs, pg_adv = _vtrace(logp, logp, rewards, values, dones, last_value,
+                         gamma, 1.0, 1.0)
+    # reference: discounted return bootstrapped from last_value
+    ret = np.zeros((T, B), np.float32)
+    acc = np.asarray(last_value)
+    for t in reversed(range(T)):
+        acc = np.asarray(rewards)[t] + gamma * acc
+        ret[t] = acc
+    np.testing.assert_allclose(np.asarray(vs), ret, rtol=1e-4, atol=1e-4)
+
+
+def test_impala_learns_cartpole_async(ray_start):
+    cfg = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .training(lr=6e-4, entropy_coeff=0.01)
+        .debugging(seed=0)
+    )
+    algo = cfg.build_algo()
+    try:
+        first = last = None
+        for _ in range(30):
+            res = algo.train()
+            if not np.isnan(res["episode_return_mean"]):
+                if first is None:
+                    first = res["episode_return_mean"]
+                last = res["episode_return_mean"]
+        assert first is not None and last is not None
+        # learning curve: clearly above the random-policy plateau
+        assert last > max(50.0, first * 1.4), (first, last)
+        # the pipeline genuinely ran async batches
+        assert res["num_batches_consumed"] >= 1
+        assert np.isfinite(res["learner/mean_is_ratio"])
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_per_policy_batches():
+    from ray_tpu.rllib.multi_agent import MultiAgentEnvRunner
+    from ray_tpu.rllib.rl_module import ActorCriticModule
+
+    env = IndependentCartPoles(n_agents=4, seed=0)
+    runner = MultiAgentEnvRunner(
+        lambda: IndependentCartPoles(n_agents=4, seed=0),
+        policy_mapping_fn=lambda a: (
+            "even" if int(a.split("_")[1]) % 2 == 0 else "odd"),
+        seed=0,
+    )
+    modules = {
+        pid: ActorCriticModule(env.observation_space, env.action_space)
+        for pid in ("even", "odd")
+    }
+    runner.set_modules(modules)
+    runner.set_weights({
+        pid: m.init(__import__("jax").random.PRNGKey(i))
+        for i, (pid, m) in enumerate(modules.items())
+    })
+    batches = runner.sample(16)
+    assert set(batches) == {"even", "odd"}
+    for sb in batches.values():
+        T, B = (int(x) for x in sb["t_b_shape"][:2])
+        assert (T, B) == (16, 2)  # 2 agents per policy
+        assert sb["obs"].shape == (32, 4)
+        assert sb["logp"].shape == (32,)
+
+
+def test_multi_agent_ppo_learning_curve():
+    algo = MultiAgentPPO(
+        lambda: IndependentCartPoles(n_agents=4, seed=0),
+        policies=["even", "odd"],
+        policy_mapping_fn=lambda a: (
+            "even" if int(a.split("_")[1]) % 2 == 0 else "odd"),
+        rollout_fragment_length=128,
+        seed=0,
+    )
+    first = last = None
+    for _ in range(20):
+        res = algo.train()
+        if not np.isnan(res["episode_return_mean"]):
+            if first is None:
+                first = res["episode_return_mean"]
+            last = res["episode_return_mean"]
+    assert first is not None and last is not None
+    assert last > max(50.0, first * 1.4), (first, last)
+    # both policies actually trained
+    assert np.isfinite(res["even/total_loss"])
+    assert np.isfinite(res["odd/total_loss"])
