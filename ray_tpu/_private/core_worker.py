@@ -117,6 +117,30 @@ _deser_borrow_batch = threading.local()
 # retention before the executor's async registration lands).
 _task_borrow_scope = threading.local()
 
+# Read-ref scope for PLAIN task execution: shm read refs taken while a
+# task's args deserialize are released once the task's reply is packed
+# — the arg values are dead, and worker-lifetime read refs would make
+# consumed intermediates (e.g. shuffle shards) unreclaimable. Actor
+# tasks deliberately do NOT use this scope: actors routinely stash arg
+# values (model weights) in self, and those zero-copy views must keep
+# their arena pages pinned.
+_task_read_scope = threading.local()
+
+
+@contextlib.contextmanager
+def _released_task_reads(worker):
+    prev = getattr(_task_read_scope, "reads", None)
+    _task_read_scope.reads = reads = []
+    try:
+        yield
+    finally:
+        _task_read_scope.reads = prev
+        for oid in reads:
+            try:
+                worker.store.release(oid)
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
+
 
 @contextlib.contextmanager
 def _confirmed_borrows(worker):
@@ -706,11 +730,27 @@ class CoreWorker:
         return ObjectRef(oid, self.address)
 
     def _write_shm(self, oid: ObjectID, meta, buffers, size: int):
+        view = None
         try:
             view = self.store.create(oid, size)
         except ObjectStoreFullError:
-            self.raylet.call_sync("ensure_space", nbytes=size)
-            view = self.store.create(oid, size)
+            # spilling `size` bytes of scattered LRU objects may not
+            # yield `size` CONTIGUOUS bytes — ask for progressively
+            # more until the allocation lands (reference: plasma's
+            # CreateRequestQueue retries create under pressure)
+            for attempt in range(6):
+                self.raylet.call_sync(
+                    "ensure_space", nbytes=min(size * (2 ** attempt),
+                                               size + (64 << 20)))
+                try:
+                    view = self.store.create(oid, size)
+                    break
+                except ObjectStoreFullError:
+                    if attempt == 5:
+                        raise
+                    # pending unref sweeps (~100ms debounce) may free
+                    # space another process just released
+                    time.sleep(0.05 * (attempt + 1))
         try:
             serialization.write_into(view, meta, buffers)
         finally:
@@ -824,13 +864,19 @@ class CoreWorker:
 
     def _read_shm_anywhere(self, oid: ObjectID, locations, deadline):
         """Read from local arena, else pull via raylet. Returns _IN_SHM
-        sentinel if unrecoverable here."""
+        sentinel if unrecoverable here.
+
+        Read refs: the zero-copy deserialized value references the
+        arena pages, so the read ref is held — by default until process
+        exit (raylet reconciles). Inside a plain-task read scope (see
+        _released_task_reads) the ref is released when the task's reply
+        has been packed: its arg values are dead then, and holding refs
+        for the worker's lifetime makes consumed intermediates
+        unreclaimable (a shuffle's working set would only ever grow)."""
         buf = self.store.get_buffer(oid)
         if buf is not None:
-            try:
-                return serialization.loads_from(buf)
-            finally:
-                pass  # keep read ref; raylet reconciles on process exit
+            self._note_task_read(oid)
+            return serialization.loads_from(buf)
         alive = self._alive_nodes()
         for node_id in list(locations):
             info = alive.get(node_id)
@@ -844,8 +890,14 @@ class CoreWorker:
             if ok:
                 buf = self.store.get_buffer(oid)
                 if buf is not None:
+                    self._note_task_read(oid)
                     return serialization.loads_from(buf)
         return _IN_SHM
+
+    def _note_task_read(self, oid: ObjectID):
+        scope = getattr(_task_read_scope, "reads", None)
+        if scope is not None:
+            scope.append(oid)
 
     def _alive_nodes(self) -> Dict[str, dict]:
         view = self.gcs.get_cluster_view()
@@ -1457,6 +1509,14 @@ class CoreWorker:
         self._free_now(oid, rec)
 
     def _free_now(self, oid: ObjectID, rec: _ObjectRecord):
+        if os.environ.get("RAY_TPU_DEBUG_FREES"):
+            import traceback
+
+            with open(os.environ["RAY_TPU_DEBUG_FREES"], "a") as f:
+                f.write(f"FREE {oid.hex()} refs={rec.local_refs} "
+                        f"borrowers={rec.borrowers} "
+                        f"pending={rec.pending}\n")
+                f.write("".join(traceback.format_stack(limit=8)) + "\n")
         self._records.pop(oid.binary(), None)
         self._maybe_free_device(oid)
         self.memory_store.delete(oid)
@@ -2145,7 +2205,11 @@ class CoreWorker:
 
     def _execute_task(self, spec: dict):
         with _confirmed_borrows(self):
-            return self._execute_task_inner(spec)
+            # release arg read-refs once the reply (with its COPIED
+            # returns) is packed; escape hatch: a task stashing a
+            # zero-copy arg view in a global must copy it first
+            with _released_task_reads(self):
+                return self._execute_task_inner(spec)
 
     def _execute_task_inner(self, spec: dict):
         self._set_log_job(spec)

@@ -245,14 +245,24 @@ class GcsServer:
         _persist_covering writer: two concurrent writers on the same
         .tmp path would interleave two pickles into one torn file, and
         crediting _persisted_seq here lets _persist_critical skip a
-        duplicate write the debounce loop already covered."""
-        if self._persist_writing is None or self._persist_writing.done():
-            self._persist_writing = asyncio.ensure_future(
-                self._persist_covering())
-        try:
-            await asyncio.shield(self._persist_writing)
-        except Exception:  # noqa: BLE001 — logged in _write_snapshot
-            pass
+        duplicate write the debounce loop already covered. Loops until
+        the entry-time seq is covered — merely joining an in-flight
+        STALE write would leave the newest mutations unpersisted with
+        _dirty already cleared."""
+        target = self._mut_seq
+        attempts = 0
+        while self._persisted_seq < target:
+            if self._persist_writing is None or \
+                    self._persist_writing.done():
+                attempts += 1
+                if attempts > 3:
+                    return  # logged in _write_snapshot
+                self._persist_writing = asyncio.ensure_future(
+                    self._persist_covering())
+            try:
+                await asyncio.shield(self._persist_writing)
+            except Exception:  # noqa: BLE001 — counted via attempts
+                pass
 
     async def _persist_loop(self):
         """Debounced atomic snapshots: coalesces bursts, loses at most

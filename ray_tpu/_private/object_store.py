@@ -49,6 +49,12 @@ class _Lib:
             lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.shm_store_evict.restype = ctypes.c_uint64
             lib.shm_store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.shm_store_set_autoevict.restype = None
+            lib.shm_store_set_autoevict.argtypes = [
+                ctypes.c_void_p, ctypes.c_int
+            ]
+            lib.shm_store_hwm.restype = ctypes.c_uint64
+            lib.shm_store_hwm.argtypes = [ctypes.c_void_p]
             lib.shm_store_reconcile.restype = ctypes.c_int
             lib.shm_store_reconcile.argtypes = [ctypes.c_void_p]
             lib.shm_store_stats.argtypes = [ctypes.c_void_p] + [
@@ -136,6 +142,18 @@ class ShmClient:
 
     def evict(self, nbytes: int) -> int:
         return int(self._lib.shm_store_evict(self._handle, ctypes.c_uint64(nbytes)))
+
+    def hwm_bytes(self) -> int:
+        """High-water mark of arena usage (peak used_bytes)."""
+        return int(self._lib.shm_store_hwm(self._handle))
+
+    def set_autoevict(self, enabled: bool) -> None:
+        """Arena-wide policy. Off = create raises ObjectStoreFullError
+        under pressure instead of silently dropping LRU objects — the
+        mode for spill-managed nodes, where eviction would lose objects
+        whose owners still hold references."""
+        self._lib.shm_store_set_autoevict(
+            self._handle, 1 if enabled else 0)
 
     def reconcile(self) -> int:
         """Drop refs held by dead processes (raylet calls this periodically)."""

@@ -204,11 +204,19 @@ class Raylet:
             cfg.shm_dir, f"ray_tpu_{os.getpid()}_{self.node_id[:12]}"
         )
         self.store = ShmClient(self.arena_path, capacity=cap, create=True)
+        if cfg.enable_spill:
+            # this raylet owns the pressure policy: creates must FAIL
+            # under pressure so the spill path engages — arena-level
+            # LRU eviction would silently drop objects whose owners
+            # still hold references (they become unrecoverable unless
+            # lineage can rebuild them)
+            self.store.set_autoevict(False)
 
         # spill
         self.spill_dir = os.path.join(cfg.spill_dir, self.node_id[:12])
         os.makedirs(self.spill_dir, exist_ok=True)
         self._spilled: Dict[bytes, str] = {}  # object_id bytes -> path
+        self._spill_events = 0  # cumulative (spill_stats RPC)
         self._inflight_pulls: Dict[bytes, asyncio.Future] = {}
         self._object_egress: Dict[bytes, int] = {}
 
@@ -1337,6 +1345,18 @@ class Raylet:
     async def object_egress_count(self, object_id: bytes) -> int:
         return self._object_egress.get(object_id, 0)
 
+    async def spill_stats(self) -> dict:
+        st = self.store.stats()
+        return {
+            "spilled_objects": len(self._spilled),
+            "spill_events": self._spill_events,
+            # arena-level LRU evictions (the native store sheds
+            # unpinned objects under create pressure)
+            "evictions": st.get("num_evictions", 0),
+            "hwm_bytes": self.store.hwm_bytes(),
+            "capacity_bytes": st.get("capacity_bytes", 0),
+        }
+
     async def has_object(self, object_id: bytes) -> bool:
         return self.store.contains(ObjectID(object_id))
 
@@ -1369,12 +1389,16 @@ class Raylet:
 
     # --- spill (reference: local_object_manager.h) ---------------------
     def _ensure_space(self, nbytes: int):
-        """Spill LRU objects to disk until ``nbytes`` fits."""
+        """Spill LRU objects to disk until ``nbytes`` (plus a
+        fragmentation margin — freed bytes are scattered, allocations
+        need contiguity) fits."""
         if not self._cfg.enable_spill:
             self.store.evict(nbytes)
             return
         stats = self.store.stats()
-        need = nbytes - (stats["capacity_bytes"] - stats["used_bytes"])
+        margin = max(4 * 1024 * 1024, nbytes // 4)
+        need = (nbytes + margin
+                - (stats["capacity_bytes"] - stats["used_bytes"]))
         if need <= 0:
             return
         for oid in self.store.list_objects_lru():  # coldest first
@@ -1388,6 +1412,7 @@ class Raylet:
                 with open(path, "wb") as f:
                     f.write(buf)
                 self._spilled[oid.binary()] = path
+                self._spill_events += 1
                 need -= buf.nbytes
             finally:
                 buf.release()

@@ -48,14 +48,32 @@ _CPP_NS = "cpp_workers"
 
 def register_cpp_worker(functions, host: str, port: int) -> None:
     """Record a C++ task server's address under each function it
-    serves. Called by the client server when a native worker announces
-    itself (client_register_cpp_worker)."""
+    serves, plus the NODE it registered from. C++ workers usually bind
+    loopback and announce through a co-located client server, so the
+    registering process's node id lets invocations pin to the right
+    node on multi-node clusters. Called by the client server
+    (client_register_cpp_worker)."""
     from ._private.core_worker import global_worker
 
     w = global_worker()
     for name in functions:
         w.gcs.kv_put(ns=_CPP_NS, key=str(name),
-                     value=f"{host}:{port}".encode())
+                     value=f"{host}:{port}|{w.node_id}".encode())
+
+
+def _resolve_cpp_worker(name: str):
+    from ._private.core_worker import global_worker
+
+    w = global_worker()
+    addr = w.gcs.kv_get(ns=_CPP_NS, key=name)
+    if addr is None:
+        raise KeyError(f"no C++ worker serves function {name!r}")
+    rec = addr.decode()
+    node_id = None
+    if "|" in rec:
+        rec, node_id = rec.rsplit("|", 1)
+    host, port = rec.rsplit(":", 1)
+    return host, int(port), node_id
 
 
 def invoke_cpp_local(name: str, payload: bytes,
@@ -67,11 +85,8 @@ def invoke_cpp_local(name: str, payload: bytes,
     from ._private.core_worker import global_worker
 
     w = global_worker()
-    addr = w.gcs.kv_get(ns=_CPP_NS, key=name)
-    if addr is None:
-        raise KeyError(f"no C++ worker serves function {name!r}")
-    host, port = addr.decode().rsplit(":", 1)
-    cli = w._pool.get(host, int(port))
+    host, port, _node = _resolve_cpp_worker(name)
+    cli = w._pool.get(host, port)
     out = cli.call_sync("invoke_cpp", fn=name, payload=bytes(payload),
                         timeout=timeout)
     return bytes(out)
@@ -100,8 +115,26 @@ def cpp_function(name: str):
     class _CppFunction:
         def __init__(self, fn_name):
             self._name = fn_name
+            self._node_id = None
 
         def remote(self, payload: bytes):
+            # pin the invoke task to the C++ worker's NODE: its server
+            # usually binds loopback, reachable only from there
+            if self._node_id is None:
+                try:
+                    _h, _p, self._node_id = _resolve_cpp_worker(
+                        self._name)
+                except KeyError:
+                    self._node_id = ""  # fail inside the task instead
+            if self._node_id:
+                from .util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy,
+                )
+
+                return _cpp_invoke_task.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        self._node_id)
+                ).remote(self._name, bytes(payload))
             return _cpp_invoke_task.remote(self._name, bytes(payload))
 
         def __repr__(self):

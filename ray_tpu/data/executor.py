@@ -316,50 +316,162 @@ class StreamingExecutor:
         for out in outs:
             yield from out
 
+    def _streaming_exchange(self, inputs: List[RefMeta], shard_fn,
+                            finalize_fn, n_out: int):
+        """Push-based exchange (reference:
+        planner/exchange/push_based_shuffle_task_scheduler.py:415):
+        mappers run in bounded waves; as EACH mapper finishes, its
+        per-partition shards merge into that partition's running
+        accumulator and the consumed shard refs drop immediately — the
+        reducers never wait behind a full map barrier, and the peak
+        working set is O(wave + accumulators) instead of every map
+        output materialized at once (which bounded the old barrier
+        exchange by one stage's worth of shm)."""
+        import os as _os
+
+        if _os.environ.get("RAY_TPU_DATA_BARRIER_EXCHANGE") == "1":
+            # reference-style full-barrier exchange, kept for A/B
+            # comparison (tests measure its peak arena usage against
+            # the streaming path's)
+            yield from self._barrier_exchange(
+                inputs, shard_fn, finalize_fn, n_out)
+            return
+        ctx = DataContext.get_current()
+        wave = max(2, ctx.max_tasks_in_flight)
+        K = 8  # shards per tree-merge node
+
+        def merge_many(*blocks):
+            rows: List[Any] = []
+            for b in blocks:
+                rows.extend(BlockAccessor.for_block(b).iter_rows())
+            return rows_to_block(rows)
+
+        remote_shard = ray.remote(shard_fn)
+        remote_merge = ray.remote(merge_many)
+        # per-partition pending shards, merged K-at-a-time into a tree
+        # (chained pairwise accumulation would COPY the whole partition
+        # every round: O(dataset x mappers) shm churn and a
+        # multi-generation peak that blows the arena)
+        parts: List[List[Any]] = [[] for _ in range(n_out)]
+        pending = collections.deque(range(len(inputs)))
+        inflight: List[Any] = []  # shard-task "done" markers
+        shard_refs_of: dict = {}  # marker -> (input index, shard refs)
+        merges_inflight: List[Any] = []
+
+        def _compact(j: int):
+            merged = remote_merge.remote(*parts[j])
+            parts[j] = [merged]
+            merges_inflight.append(merged)
+            # bound outstanding merge work: shard tasks must not race
+            # ahead of the reducers and pile shards up in shm
+            while len(merges_inflight) > wave:
+                oldest = merges_inflight.pop(0)
+                ready, _ = ray.wait([oldest], num_returns=1, timeout=600)
+                if not ready:
+                    raise TimeoutError(
+                        "exchange merge task made no progress in 600s")
+
+        stalls = 0
+        while pending or inflight:
+            while pending and len(inflight) < wave:
+                i = pending.popleft()
+                ref, _meta = inputs[i]
+                # one return object PER PARTITION: each shard is
+                # independently mergeable (and freeable)
+                refs = remote_shard.options(
+                    num_returns=n_out).remote(ref, i)
+                if n_out == 1:
+                    refs = [refs]
+                marker = refs[0]
+                shard_refs_of[marker] = (i, refs)
+                inflight.append(marker)
+            done, inflight = ray.wait(inflight, num_returns=1,
+                                      timeout=600)
+            if not done:
+                stalls += 1
+                if stalls >= 2:  # a silent-spin loop would hang forever
+                    raise TimeoutError(
+                        "exchange shard tasks made no progress in 1200s")
+                continue
+            stalls = 0
+            for marker in done:
+                i, refs = shard_refs_of.pop(marker)
+                # the input block is fully sharded: CONSUME the caller's
+                # ref so its shm frees now, not at stage end (the input
+                # list is owned by this exchange)
+                inputs[i] = None
+                for j in range(n_out):
+                    parts[j].append(refs[j])
+                    if len(parts[j]) >= K:
+                        _compact(j)
+                # dropping the shard refs leaves the merge tasks' arg
+                # retention as their only anchor: freed on consumption
+                del refs
+
+        remote_finalize = ray.remote(finalize_fn)
+        final_refs = [remote_finalize.remote(j, *parts[j])
+                      for j in range(n_out)]
+        del parts
+        for out in ray.get(final_refs, timeout=600):
+            yield from out
+
+    def _barrier_exchange(self, inputs: List[RefMeta], shard_fn,
+                          finalize_fn, n_out: int):
+        """Full-barrier exchange: every map output materialized before
+        any reduce starts (the pre-push design; peak arena usage =
+        inputs + ALL shards + outputs)."""
+        remote_shard = ray.remote(shard_fn)
+        all_refs = []
+        for i, (ref, _meta) in enumerate(inputs):
+            refs = remote_shard.options(num_returns=n_out).remote(ref, i)
+            all_refs.append([refs] if n_out == 1 else refs)
+        # barrier: wait for the whole map side
+        flat = [r for refs in all_refs for r in refs]
+        ray.wait(flat, num_returns=len(flat), timeout=600)
+        remote_finalize = ray.remote(finalize_fn)
+        final_refs = [
+            remote_finalize.remote(j, *[refs[j] for refs in all_refs])
+            for j in range(n_out)
+        ]
+        for out in ray.get(final_refs, timeout=600):
+            yield from out
+
     def _random_shuffle(self, inputs: List[RefMeta], seed):
         n_out = max(1, len(inputs))
+        # seeds are drawn in the DRIVER and close over the task fns: a
+        # retried/lineage-reconstructed mapper must partition rows
+        # EXACTLY like its first run, or rebuilt shards would overlap
+        # the already-merged ones (duplicated + dropped rows)
+        map_seeds = [
+            (seed * 1000 + i if seed is not None
+             else random.randrange(1 << 30))
+            for i in range(len(inputs))
+        ]
+        out_seeds = [
+            (seed * 7919 + j if seed is not None
+             else random.randrange(1 << 30))
+            for j in range(n_out)
+        ]
 
-        def shard_task(block, n, seed_i):
-            rng = random.Random(seed_i)
-            rows = list(BlockAccessor.for_block(block).iter_rows())
-            shards: List[List[Any]] = [[] for _ in range(n)]
-            for r in rows:
-                shards[rng.randrange(n)].append(r)
-            return [
-                (lambda b: (ray.put(b), _meta_of(b)))(rows_to_block(s))
-                for s in shards
-            ]
+        def shard_fn(block, i):
+            rng = random.Random(map_seeds[i])
+            shards: List[List[Any]] = [[] for _ in range(n_out)]
+            for r in BlockAccessor.for_block(block).iter_rows():
+                shards[rng.randrange(n_out)].append(r)
+            out = tuple(rows_to_block(s) for s in shards)
+            return out if n_out > 1 else out[0]
 
-        def reduce_task(seed_i, *shards):
-            rows = []
-            for s in shards:
-                rows.extend(BlockAccessor.for_block(s).iter_rows())
-            rng = random.Random(seed_i)
+        def finalize_fn(j, *blocks):
+            rows: List[Any] = []
+            for b in blocks:
+                rows.extend(BlockAccessor.for_block(b).iter_rows())
+            rng = random.Random(out_seeds[j])
             rng.shuffle(rows)
             b = rows_to_block(rows)
             return [(ray.put(b), _meta_of(b))]
 
-        remote_shard = ray.remote(shard_task)
-        remote_reduce = ray.remote(reduce_task)
-        shard_lists = ray.get(
-            [
-                remote_shard.remote(ref, n_out,
-                                    (seed or 0) * 1000 + i if seed is not None
-                                    else random.randrange(1 << 30))
-                for i, (ref, _) in enumerate(inputs)
-            ],
-            timeout=600,
-        )
-        for j in range(n_out):
-            shards_j = [sl[j][0] for sl in shard_lists]
-            yield from ray.get(
-                remote_reduce.remote(
-                    (seed or 0) * 7919 + j if seed is not None
-                    else random.randrange(1 << 30),
-                    *shards_j,
-                ),
-                timeout=600,
-            )
+        yield from self._streaming_exchange(
+            inputs, shard_fn, finalize_fn, n_out)
 
     def _sort(self, inputs: List[RefMeta], key, descending: bool):
         # sample boundaries -> range partition -> per-partition sort
@@ -384,22 +496,20 @@ class StreamingExecutor:
             for i in range(n_out - 1)
         ] if samples else []
 
-        def partition_task(block, bounds):
+        def shard_fn(block, _i):
             import bisect
 
-            shards: List[List[Any]] = [[] for _ in range(len(bounds) + 1)]
+            shards: List[List[Any]] = [[] for _ in range(n_out)]
             for r in BlockAccessor.for_block(block).iter_rows():
                 v = r[key] if isinstance(r, dict) else r
                 shards[bisect.bisect_left(bounds, v)].append(r)
-            return [
-                (lambda b: (ray.put(b), _meta_of(b)))(rows_to_block(s))
-                for s in shards
-            ]
+            out = tuple(rows_to_block(s) for s in shards)
+            return out if n_out > 1 else out[0]
 
-        def sort_task(*shards):
-            rows = []
-            for s in shards:
-                rows.extend(BlockAccessor.for_block(s).iter_rows())
+        def finalize_fn(_j, *blocks):
+            rows: List[Any] = []
+            for b in blocks:
+                rows.extend(BlockAccessor.for_block(b).iter_rows())
             rows.sort(
                 key=(lambda r: r[key] if isinstance(r, dict) else r),
                 reverse=descending,
@@ -407,19 +517,11 @@ class StreamingExecutor:
             b = rows_to_block(rows)
             return [(ray.put(b), _meta_of(b))]
 
-        shard_lists = ray.get(
-            [
-                ray.remote(partition_task).remote(ref, bounds)
-                for ref, _ in inputs
-            ],
-            timeout=600,
-        )
-        part_range = range(n_out - 1, -1, -1) if descending else range(n_out)
-        for j in part_range:
-            shards_j = [sl[j][0] for sl in shard_lists]
-            yield from ray.get(
-                ray.remote(sort_task).remote(*shards_j), timeout=600
-            )
+        # push-based range exchange; partitions stream through the same
+        # merge pipeline as shuffle, then emit in key order
+        outs = list(self._streaming_exchange(
+            inputs, shard_fn, finalize_fn, n_out))
+        yield from (reversed(outs) if descending else outs)
 
     def _groupby(self, inputs: List[RefMeta], params):
         key = params["key"]

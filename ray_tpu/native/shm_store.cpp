@@ -83,6 +83,14 @@ struct Header {
   uint64_t used_bytes;
   uint64_t num_objects;
   uint64_t num_evictions;
+  // 1 (default): create evicts LRU objects under pressure (standalone
+  // arenas). 0: create returns OOM instead, so an external policy
+  // (the raylet's spill-to-disk) decides — silent eviction would drop
+  // objects whose owners still hold references (reference: plasma
+  // never evicts referenced objects; the CreateRequestQueue
+  // blocks/spills, store.h:55 + eviction_policy.h).
+  uint64_t autoevict;
+  uint64_t hwm_bytes;  // high-water mark of used_bytes (observability)
 };
 
 // Boundary-tag heap block header. Blocks are 64-byte aligned; `size` includes
@@ -167,6 +175,8 @@ uint64_t heap_alloc(Store* s, uint64_t need) {
         b->size = blk_size(b) | 1ULL;
       }
       s->hdr->used_bytes += blk_size(b);
+      if (s->hdr->used_bytes > s->hdr->hwm_bytes)
+        s->hdr->hwm_bytes = s->hdr->used_bytes;
       return off + kBlockHdr;
     }
     off = b->next_free;
@@ -408,6 +418,7 @@ void* shm_store_open(const char* path, uint64_t arena_size, int create) {
     Header* h = s->hdr;
     memset(h, 0, sizeof(Header));
     h->arena_size = arena_size;
+    h->autoevict = 1;
     // size table: one entry per expected 16KB of heap, min 4096 slots,
     // capped at 1M (a fresh ftruncate'd tmpfs file reads as zeros, so no
     // memset is needed -- zero == kEmpty/free slot).
@@ -522,8 +533,11 @@ int shm_store_create(void* hs, const uint8_t* id, uint64_t size, uint64_t* out_o
   uint64_t off = heap_alloc(s, size);
   // Evicting `size` bytes total may not produce `size` *contiguous* bytes
   // (fragmentation), so loop: evict LRU victims and retry until the
-  // allocation succeeds or no evictable objects remain.
+  // allocation succeeds or no evictable objects remain. Skipped when
+  // autoevict is off (spill-managed arenas): the caller gets -2 and
+  // the node policy spills instead of silently dropping live objects.
   while (off == kNullOff) {
+    if (!s->hdr->autoevict) break;
     if (evict_lru(s, size) == 0) break;
     off = heap_alloc(s, size);
   }
@@ -632,6 +646,17 @@ int shm_store_delete(void* hs, const uint8_t* id) {
   }
   unlock(s);
   return 0;
+}
+
+uint64_t shm_store_hwm(void* hs) {
+  return reinterpret_cast<Store*>(hs)->hdr->hwm_bytes;
+}
+
+void shm_store_set_autoevict(void* hs, int enabled) {
+  Store* s = reinterpret_cast<Store*>(hs);
+  lock(s);
+  s->hdr->autoevict = enabled ? 1 : 0;
+  unlock(s);
 }
 
 uint64_t shm_store_evict(void* hs, uint64_t nbytes) {
