@@ -2548,6 +2548,7 @@ class CoreWorker:
         return {"results": results}
 
     async def _drain_caller_queue(self, q: "_CallerQueue"):
+        run: List[tuple] = []  # contiguous serialized (spec, fut) run
         try:
             while True:
                 while q.next_seq in q.abandoned:
@@ -2571,20 +2572,26 @@ class CoreWorker:
                              and not spec.get("concurrency_group"))
                 if serialize:
                     # default-lane serialization WITHOUT blocking this
-                    # drain loop: executions chain through a FIFO lane
-                    # lock (dispatch order = seq order = wake order),
-                    # so a long default method can't starve group-lane
-                    # calls queued behind it
+                    # drain loop: CONTIGUOUS serialized tasks coalesce
+                    # into one executor hop (the per-task loop->thread
+                    # round trip dominates trivial methods), chained
+                    # through a FIFO lane lock so a long method never
+                    # starves group-lane calls queued behind it
                     if self._default_lane_lock is None:
                         self._default_lane_lock = asyncio.Lock()
-                    asyncio.ensure_future(
-                        self._run_serialized(spec, fut))
+                    run.append((spec, fut))
                 else:
+                    if run:
+                        asyncio.ensure_future(
+                            self._run_serialized_batch(run))
+                        run = []
                     # ordered dispatch, concurrent execution
                     asyncio.ensure_future(
                         self._run_and_resolve(spec, fut)
                     )
         finally:
+            if run:
+                asyncio.ensure_future(self._run_serialized_batch(run))
             q.draining = False
             # a push may have arrived for the new next_seq while we exited
             if q.next_seq in q.buffer or (
@@ -2608,6 +2615,27 @@ class CoreWorker:
         order)."""
         async with self._default_lane_lock:
             await self._run_and_resolve(spec, fut)
+
+    async def _run_serialized_batch(self, items: List[tuple]):
+        """Run a contiguous run of serialized tasks in ONE executor
+        hop, resolving each reply future as its task completes (an
+        early caller's get() must not wait for later batchmates)."""
+        async with self._default_lane_lock:
+            loop = asyncio.get_running_loop()
+
+            def _resolve(fut, reply):
+                if not fut.done():
+                    fut.set_result(reply)
+
+            def run_all():
+                for spec, fut in items:
+                    try:
+                        reply = self._execute_actor_task_sync(spec)
+                    except Exception as e:  # noqa: BLE001
+                        reply = self._actor_error_reply(spec, e)
+                    loop.call_soon_threadsafe(_resolve, fut, reply)
+
+            await loop.run_in_executor(self._actor_executor, run_all)
 
     async def _run_actor_method(self, spec: dict):
         loop = asyncio.get_running_loop()
