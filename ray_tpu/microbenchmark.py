@@ -170,13 +170,29 @@ def bench_init():
         "RAY_TPU_BENCH_CPUS", max(8, 2 * (os.cpu_count() or 1))))})
 
 
+def _host_memcpy_gib_s() -> float:
+    """Raw single-thread memcpy bandwidth: the hardware ceiling for
+    put/get GiB/s rows (the reference's machines had several times this
+    host's memory bandwidth — ratios need the denominator recorded)."""
+    a = np.ones(32 * 1024 * 1024 // 8, dtype=np.int64)
+    b = np.empty_like(a)
+    b[:] = a  # warm
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 0.5:
+        b[:] = a
+        n += 1
+    return round(n * 32 / 1024 / (time.perf_counter() - t0), 2)
+
+
 def write_bench_json(filename: str, payload: dict):
     """Write a benchmark JSON next to the repo root (fallback: cwd)."""
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), filename)
     if not os.path.isdir(os.path.dirname(path)):
         path = filename
-    payload = dict(payload, host_cpus=os.cpu_count())
+    payload = dict(payload, host_cpus=os.cpu_count(),
+                   host_memcpy_gib_s=_host_memcpy_gib_s())
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)
     print(f"wrote {path}")
