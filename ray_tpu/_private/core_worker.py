@@ -25,6 +25,7 @@ import os
 import threading
 import time
 import traceback
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
@@ -118,12 +119,18 @@ _deser_borrow_batch = threading.local()
 _task_borrow_scope = threading.local()
 
 # Read-ref scope for PLAIN task execution: shm read refs taken while a
-# task's args deserialize are released once the task's reply is packed
-# — the arg values are dead, and worker-lifetime read refs would make
-# consumed intermediates (e.g. shuffle shards) unreclaimable. Actor
-# tasks deliberately do NOT use this scope: actors routinely stash arg
-# values (model weights) in self, and those zero-copy views must keep
-# their arena pages pinned.
+# task's args deserialize are released when the LAST zero-copy view into
+# the object dies (a weakref.finalize on the out-of-band buffer wrappers
+# — serialization.TrackedBuffer). For the common task this is the moment
+# the reply is packed (arg values are dead), so consumed intermediates
+# (e.g. shuffle shards) stay reclaimable; for a task that stashes a view
+# past its own execution (module-level cache of a ray.get() array — safe
+# in the reference, where plasma pins follow the PyBuffer lifetime) the
+# ref is held until that view is GC'd, so the pages can never be reused
+# under a live view. Objects with no out-of-band buffers deserialize as
+# full copies and release at scope exit. Actor tasks deliberately do NOT
+# use this scope: actors routinely stash arg values (model weights) in
+# self, and worker-lifetime refs there are intended.
 _task_read_scope = threading.local()
 
 
@@ -875,8 +882,7 @@ class CoreWorker:
         unreclaimable (a shuffle's working set would only ever grow)."""
         buf = self.store.get_buffer(oid)
         if buf is not None:
-            self._note_task_read(oid)
-            return serialization.loads_from(buf)
+            return self._loads_shm(oid, buf)
         alive = self._alive_nodes()
         for node_id in list(locations):
             info = alive.get(node_id)
@@ -890,14 +896,55 @@ class CoreWorker:
             if ok:
                 buf = self.store.get_buffer(oid)
                 if buf is not None:
-                    self._note_task_read(oid)
-                    return serialization.loads_from(buf)
+                    return self._loads_shm(oid, buf)
         return _IN_SHM
 
-    def _note_task_read(self, oid: ObjectID):
+    def _loads_shm(self, oid: ObjectID, buf):
+        """Deserialize a shm object, managing the read ref get_buffer took.
+
+        Outside a plain-task read scope: ref held for the worker's
+        lifetime (raylet reconciles on exit), as before. Inside the
+        scope: tie release to the GC of the zero-copy buffer wrappers,
+        so a view escaping the task keeps its pages pinned (see
+        _task_read_scope comment)."""
         scope = getattr(_task_read_scope, "reads", None)
-        if scope is not None:
+        if scope is None:
+            return serialization.loads_from(buf)
+        sink: list = []
+        try:
+            value = serialization.loads_from(buf, buffer_sink=sink.append)
+        except BaseException:
+            # unpickle failed: no value escaped, no finalizers were
+            # registered — release the ref get_buffer took, or the
+            # pages stay pinned for the worker's lifetime
+            try:
+                self.store.release(oid)
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        wrappers = sink[0] if sink else []
+        if not wrappers:
+            # Fully in-band object: the value is a copy, no view can
+            # reference arena pages — release at scope exit as before.
             scope.append(oid)
+            return value
+        store = self.store
+        lock = threading.Lock()
+        remaining = [len(wrappers)]
+
+        def _buffer_dead():
+            with lock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            try:
+                store.release(oid)
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
+
+        for w in wrappers:
+            weakref.finalize(w, _buffer_dead)
+        return value
 
     def _alive_nodes(self) -> Dict[str, dict]:
         view = self.gcs.get_cluster_view()
@@ -909,8 +956,9 @@ class CoreWorker:
         """Proactively replicate a shm object to other nodes via a
         spanning-tree push (reference: push_manager.h — owner-side push
         so an N-node broadcast doesn't N-fold the origin's egress).
-        Returns the number of target nodes. Inline (small) objects are
-        a no-op: their value already travels with the ref."""
+        Returns the number of CONFIRMED deliveries (may be < the number
+        of targets when nodes are unreachable). Inline (small) objects
+        are a no-op: their value already travels with the ref."""
         oid = ref.id
         if not self.store.contains(oid):
             if self.memory_store.contains(oid):
@@ -2467,8 +2515,18 @@ class CoreWorker:
         self.actor_instance = cls(*args, **kwargs)
         self.actor_id = actor_id
         self._max_concurrency = info.get("max_concurrency", 1)
+        # Async actor (any async-def method): max_concurrency bounds the
+        # number of INTERLEAVED coroutines, but sync methods serialize
+        # through the default lane — the reference runs them on the one
+        # event loop, where they block it, so two sync methods of an
+        # async actor never race each other's `self` mutations.
+        self._is_async_actor = any(
+            asyncio.iscoroutinefunction(getattr(self.actor_instance, n, None))
+            for n in dir(self.actor_instance) if not n.startswith("__")
+        )
         self._actor_executor = ThreadPoolExecutor(
-            max_workers=self._max_concurrency
+            max_workers=1 if self._is_async_actor
+            else self._max_concurrency
         )
         # named concurrency groups (reference:
         # concurrency_group_manager.h): each group is an execution lane
@@ -2567,10 +2625,17 @@ class CoreWorker:
                     method
                 )
                 # group-routed methods run in their own lane: never
-                # serialize them into the default seq-ordered execution
-                serialize = (self._max_concurrency == 1 and not is_async
-                             and not spec.get("concurrency_group"))
-                if serialize:
+                # serialize them into the default seq-ordered execution.
+                # Sync methods of an ASYNC actor always serialize: the
+                # reference runs them on the single event loop, so they
+                # can never race each other regardless of the coroutine
+                # interleaving cap (max_concurrency).
+                serialize = (not spec.get("concurrency_group")) and (
+                    self._max_concurrency == 1
+                    or (not is_async and getattr(
+                        self, "_is_async_actor", False))
+                )
+                if serialize and not is_async:
                     # default-lane serialization WITHOUT blocking this
                     # drain loop: CONTIGUOUS serialized tasks coalesce
                     # into one executor hop (the per-task loop->thread
@@ -2585,10 +2650,23 @@ class CoreWorker:
                         asyncio.ensure_future(
                             self._run_serialized_batch(run))
                         run = []
-                    # ordered dispatch, concurrent execution
-                    asyncio.ensure_future(
-                        self._run_and_resolve(spec, fut)
-                    )
+                    if serialize:
+                        # async-def method on a max_concurrency=1
+                        # actor: the lane lock (FIFO) makes dispatch
+                        # order imply START order, so a later call
+                        # never begins before a queued earlier sync
+                        # method runs — matching the reference, where
+                        # one event loop + concurrency cap 1 fully
+                        # serializes the actor
+                        if self._default_lane_lock is None:
+                            self._default_lane_lock = asyncio.Lock()
+                        asyncio.ensure_future(
+                            self._run_serialized(spec, fut))
+                    else:
+                        # ordered dispatch, concurrent execution
+                        asyncio.ensure_future(
+                            self._run_and_resolve(spec, fut)
+                        )
         finally:
             if run:
                 asyncio.ensure_future(self._run_serialized_batch(run))

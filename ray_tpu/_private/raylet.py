@@ -1311,10 +1311,31 @@ class Raylet:
                     return int(n)
             except Exception:
                 pass
-            # the head failed (unreachable, or its pull returned 0):
-            # its whole subtree would be orphaned — re-fan the
-            # remainder from here (degraded but correct)
-            return await self._fanout_object(object_id, rest)
+            # The head failed (unreachable, pull returned 0, or the
+            # call TIMED OUT after partially succeeding): its subtree
+            # would be orphaned — re-fan from here, but first probe
+            # which nodes already hold a copy (a timed-out push may
+            # have delivered some), so they are neither re-pushed nor
+            # double-counted. The head is probed for COUNTING only —
+            # it is never re-entered into the fanout, which is what
+            # guarantees termination when a node is persistently down.
+            async def probe(addr) -> bool:
+                try:
+                    p = self._pool.get(addr[0], int(addr[1]))
+                    return bool(await p.call(
+                        "has_object", object_id=object_id, timeout=5.0))
+                except Exception:
+                    return False  # unreachable probes re-enter the fanout
+
+            # probes are independent: gather them so unreachable nodes
+            # cost ONE 5s timeout, not 5s × N serialized on exactly the
+            # degraded path this recovery is meant to speed up
+            head_has, *rest_has = await asyncio.gather(
+                probe(head), *[probe(t) for t in rest])
+            already = int(head_has) + sum(rest_has)
+            remainder = [t for t, h in zip(rest, rest_has) if not h]
+            return already + await self._fanout_object(
+                object_id, remainder)
 
         counts = await asyncio.gather(*[send(h) for h in halves if h])
         return sum(counts)

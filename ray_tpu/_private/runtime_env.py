@@ -25,14 +25,23 @@ Supported pip forms (mirrors the reference's schema):
 from __future__ import annotations
 
 import asyncio
+import fcntl
 import hashlib
 import json
 import os
 import shutil
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+
+# Cross-process build lock liveness: the holder touches the lockfile
+# every _LOCK_HEARTBEAT seconds; waiters break locks whose mtime is
+# older than _LOCK_STALE (several missed heartbeats ⇒ the builder died).
+_LOCK_HEARTBEAT = 10.0
+_LOCK_STALE = 60.0
 
 
 def _pip_packages(runtime_env: dict) -> List[str]:
@@ -130,10 +139,15 @@ class RuntimeEnvManager:
         # cross-PROCESS build guard (the asyncio lock covers only this
         # raylet): O_EXCL lock file; a second raylet sharing the session
         # dir waits for the winner's .ready instead of corrupting the
-        # half-built venv. A stale lock (builder killed mid-build) is
-        # broken after its mtime ages past the build timeout.
+        # half-built venv. The holder HEARTBEATS the lock (a timer
+        # thread touches its mtime every _LOCK_HEARTBEAT seconds), so
+        # staleness is judged against the heartbeat interval — a live
+        # build can run arbitrarily long (venv + pip + per-module
+        # installs are each separate subprocess timeouts) without a
+        # waiter breaking its lock; only a builder that died mid-build
+        # leaves an un-touched lock to reap.
         lockfile = os.path.join(envdir, ".building")
-        deadline = time.time() + 660
+        deadline = time.time() + 3600  # give up WAITING (never breaks a live lock)
         while True:
             try:
                 fd = os.open(lockfile, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -150,12 +164,34 @@ class RuntimeEnvManager:
                     age = time.time() - os.path.getmtime(lockfile)
                 except OSError:
                     continue  # winner just removed it; retry
-                if age > 660 or time.time() > deadline:
+                if age > _LOCK_STALE:
+                    # Reap under an flock guard: two waiters that both
+                    # observed a stale mtime must not BOTH unlink — the
+                    # second would remove the fresh lock the first just
+                    # recreated, letting two builders run. With the
+                    # guard held, staleness is re-checked and the
+                    # unlink is atomic w.r.t. other breakers.
                     try:
-                        os.unlink(lockfile)  # stale: builder died
+                        guard = open(lockfile + ".reaplock", "a")
                     except OSError:
-                        pass
+                        continue
+                    try:
+                        fcntl.flock(guard, fcntl.LOCK_EX)
+                        try:
+                            if (time.time() - os.path.getmtime(lockfile)
+                                    > _LOCK_STALE):
+                                os.unlink(lockfile)  # stale: builder died
+                        except OSError:
+                            pass
+                    finally:
+                        guard.close()  # closes fd ⇒ drops the flock
                     continue
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"timed out waiting for a concurrent runtime_env "
+                        f"build holding {lockfile} (still heartbeating "
+                        f"after 3600s)"
+                    )
                 time.sleep(0.2)
                 continue
             except FileNotFoundError:
@@ -176,6 +212,19 @@ class RuntimeEnvManager:
                 )
             break
         log = open(logpath, "ab")
+        hb_stop = threading.Event()
+
+        def _heartbeat():
+            while not hb_stop.wait(_LOCK_HEARTBEAT):
+                try:
+                    os.utime(lockfile, None)
+                except OSError:
+                    return  # lock gone (build finished/cleaned): stop
+
+        hb = threading.Thread(
+            target=_heartbeat, name="runtime-env-lock-heartbeat", daemon=True
+        )
+        hb.start()
         try:
             python, pythonpath = None, []
             pkgs = _pip_packages(runtime_env)
@@ -206,6 +255,7 @@ class RuntimeEnvManager:
                 f"(log: {logpath}):\n{tail}"
             ) from None
         finally:
+            hb_stop.set()
             log.close()
 
     def _run(self, cmd: List[str], log) -> None:

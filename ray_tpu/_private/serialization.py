@@ -85,8 +85,32 @@ def dumps(obj: Any) -> bytes:
     return bytes(out)
 
 
-def loads_from(view: memoryview) -> Any:
-    """Deserialize from a (possibly shm-backed) memoryview, zero-copy."""
+class TrackedBuffer:
+    """PEP-688 buffer wrapper around a shm-backed view.
+
+    Zero-copy consumers (numpy arrays reconstructed from pickle5
+    out-of-band buffers) hold this object in their ``.base`` chain, so a
+    ``weakref.finalize`` on it observes exactly when the LAST Python view
+    into the underlying arena pages dies — the moment the store read ref
+    can safely be released (the reference ties plasma buffer pins to the
+    PyBuffer lifetime the same way, plasma/client.cc)."""
+
+    __slots__ = ("_view", "__weakref__")
+
+    def __init__(self, view: memoryview):
+        self._view = view
+
+    def __buffer__(self, flags):
+        return self._view
+
+
+def loads_from(view: memoryview, buffer_sink=None) -> Any:
+    """Deserialize from a (possibly shm-backed) memoryview, zero-copy.
+
+    If ``buffer_sink`` is given, each out-of-band buffer is wrapped in a
+    :class:`TrackedBuffer` and the list of wrappers is passed to
+    ``buffer_sink`` before unpickling — callers use this to tie store
+    read-ref release to the wrappers' GC instead of a fixed scope."""
     off = 0
     (meta_len,) = _HEADER.unpack_from(view, off)
     off += _HEADER.size
@@ -102,6 +126,9 @@ def loads_from(view: memoryview) -> Any:
         off += _HEADER.size + pad
         buffers.append(view[off : off + nbytes].toreadonly())
         off += nbytes
+    if buffer_sink is not None:
+        buffers = [TrackedBuffer(b) for b in buffers]
+        buffer_sink(buffers)
     from .core_worker import batching_borrows
 
     with batching_borrows():

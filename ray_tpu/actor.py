@@ -136,6 +136,24 @@ def _public_methods(cls) -> Dict[str, Any]:
     return methods
 
 
+def _default_max_concurrency(cls) -> int:
+    """Async actors (any async-def method) default to 1000 concurrent
+    in-flight methods, like the reference (python/ray/actor.py — async
+    actors get max_concurrency=1000 unless set); sync actors default to
+    1 (serialized). An explicit max_concurrency=1 on an async actor
+    serializes its methods through the default lane (see
+    core_worker._drain_caller_queue)."""
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        fn = inspect.getattr_static(cls, name, None)
+        if fn is not None and inspect.iscoroutinefunction(
+            getattr(cls, name, None)
+        ):
+            return 1000
+    return 1
+
+
 def method(num_returns: int = 1, tensor_transport: Optional[str] = None,
            concurrency_group: Optional[str] = None):
     """@ray_tpu.method(num_returns=N, tensor_transport="device",
@@ -208,7 +226,8 @@ class ActorClass:
             namespace=o.get("namespace", ""),
             max_restarts=o.get("max_restarts", 0),
             max_task_retries=o.get("max_task_retries", 0),
-            max_concurrency=o.get("max_concurrency", 1),
+            max_concurrency=o.get("max_concurrency")
+            or _default_max_concurrency(self._cls),
             concurrency_groups=o.get("concurrency_groups"),
             detached=lifetime == "detached",
             strategy=strategy,

@@ -172,6 +172,10 @@ class ServeController:
                 except Exception:
                     if not rep.get("started") and (
                             now - rep["created_at"] < grace):
+                        # throttle the re-probe too: without this a
+                        # multi-minute model load eats a blocking 10s
+                        # probe per booting replica EVERY tick
+                        rep["last_health"] = now
                         alive.append(rep)  # still booting
                         continue
                     # tolerate transient stalls (recompiles, CPU

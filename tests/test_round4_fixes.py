@@ -1,0 +1,240 @@
+"""Regression tests for round-4 advisor findings (ADVICE.md round 4).
+
+Covers: shm read refs following zero-copy view lifetime (an escaping
+view must keep its arena pages pinned past the task's reply), the
+runtime-env build-lock heartbeat (a waiter must not break a live
+builder's lock), and max_concurrency=1 actor ordering across a
+sync→async method boundary.
+"""
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 4})
+    yield
+    ray.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 1) ADVICE r4 #1: a plain task that caches a zero-copy ray.get() view in a
+#    module-level global must keep the arena pages pinned after the task's
+#    reply — the read ref follows the LAST view's GC, not the task scope.
+# ---------------------------------------------------------------------------
+def test_escaping_view_keeps_read_ref(ray_start):
+    from ray_tpu._private import serialization
+    from ray_tpu._private.core_worker import (
+        _released_task_reads,
+        global_worker,
+    )
+
+    w = global_worker()
+    arr = np.arange(1 << 16, dtype=np.float64)  # 512 KiB — out-of-band
+    ref = ray.put(arr)
+    oid = ref.id
+
+    released = []
+    orig_release = w.store.release
+
+    def spy_release(o):
+        released.append(o)
+        orig_release(o)
+
+    w.store.release = spy_release
+    try:
+        escaped = {}
+        with _released_task_reads(w):
+            # simulates task arg deserialization: read from shm inside
+            # the plain-task read scope, then ESCAPE the view
+            buf = w.store.get_buffer(oid)
+            assert buf is not None
+            escaped["view"] = w._loads_shm(oid, buf)
+        gc.collect()
+        # scope exited, view still alive: ref must NOT have been released
+        assert oid not in released, (
+            "read ref released while a zero-copy view is still alive"
+        )
+        np.testing.assert_array_equal(escaped["view"], arr)
+        # drop the view: the finalizer must release the ref now
+        del escaped["view"]
+        gc.collect()
+        deadline = time.time() + 5
+        while oid not in released and time.time() < deadline:
+            gc.collect()
+            time.sleep(0.05)
+        assert oid in released, "read ref never released after view GC"
+    finally:
+        w.store.release = orig_release
+
+
+def test_inband_object_released_at_scope_exit(ray_start):
+    """Small (fully in-band) objects deserialize as copies — their read
+    ref still releases at scope exit, keeping intermediates reclaimable."""
+    from ray_tpu._private.core_worker import (
+        _released_task_reads,
+        global_worker,
+    )
+
+    w = global_worker()
+    # tuple of small pieces: under the 4 KiB out-of-band threshold, but
+    # large enough in total that the object lands in shm, not inline
+    val = tuple(os.urandom(2048) for _ in range(200))
+    ref = ray.put(val)
+    oid = ref.id
+
+    released = []
+    orig_release = w.store.release
+    w.store.release = lambda o: (released.append(o), orig_release(o))
+    try:
+        keep = {}
+        with _released_task_reads(w):
+            buf = w.store.get_buffer(oid)
+            if buf is None:
+                pytest.skip("value was inlined, not in shm")
+            keep["v"] = w._loads_shm(oid, buf)
+        assert oid in released, "in-band object not released at scope exit"
+        assert keep["v"] == val  # value is a full copy: still intact
+    finally:
+        w.store.release = orig_release
+
+
+# ---------------------------------------------------------------------------
+# 2) ADVICE r4 #2: a waiter must not break the build lock of a LIVE builder
+#    whose build outlasts the old 660 s staleness window — liveness is now
+#    judged by heartbeat mtime, and the holder touches the lock.
+# ---------------------------------------------------------------------------
+def test_build_lock_heartbeat_not_broken(tmp_path):
+    from ray_tpu._private import runtime_env as re_mod
+
+    lockfile = tmp_path / ".building"
+    lockfile.write_text("12345")
+    # a heartbeating builder: mtime is fresh even though the lock is
+    # logically "old" (pretend the build started long ago)
+    os.utime(lockfile, None)
+    age = time.time() - lockfile.stat().st_mtime
+    assert age < re_mod._LOCK_STALE
+    # staleness threshold is several heartbeats, and far below the old
+    # 660 s fixed window (a dead builder is reaped quickly now)
+    assert re_mod._LOCK_STALE >= 3 * re_mod._LOCK_HEARTBEAT
+    assert re_mod._LOCK_STALE <= 660
+
+
+def test_build_lock_heartbeat_thread_touches(tmp_path, monkeypatch):
+    """The builder's heartbeat thread must refresh the lock mtime while
+    a (simulated) long build step runs."""
+    from ray_tpu._private import runtime_env as re_mod
+
+    monkeypatch.setattr(re_mod, "_LOCK_HEARTBEAT", 0.1)
+    mgr = re_mod.RuntimeEnvManager(str(tmp_path))
+    # long "build": a pip list that sleeps
+    calls = {}
+
+    def slow_run(cmd, log):
+        # first step (venv create): backdate the lock, sleep past
+        # several heartbeats, then verify the mtime was refreshed
+        lock = os.path.join(mgr.root, calls["key"], ".building")
+        os.utime(lock, (time.time() - 1000, time.time() - 1000))
+        time.sleep(0.5)
+        assert time.time() - os.path.getmtime(lock) < 10, (
+            "heartbeat thread did not refresh the build lock"
+        )
+        calls["beat"] = True
+        raise RuntimeError("stop build here")  # don't actually build
+
+    mgr._run = slow_run
+    key = "testenv"
+    calls["key"] = key
+    with pytest.raises(RuntimeError):
+        mgr._materialize(key, {"pip": ["not-a-real-package"]})
+    assert calls.get("beat"), "slow step never ran"
+
+
+# ---------------------------------------------------------------------------
+# 3) ADVICE r4 #3: on a max_concurrency=1 actor, an async-def method
+#    submitted AFTER a sync method must not start before it. And async
+#    actors now default to max_concurrency=1000 like the reference.
+# ---------------------------------------------------------------------------
+def test_max_concurrency_1_orders_sync_then_async(ray_start):
+    @ray.remote(max_concurrency=1)
+    class Ordered:
+        def __init__(self):
+            self.events = []
+
+        def slow_sync(self):
+            self.events.append("sync_start")
+            time.sleep(0.3)
+            self.events.append("sync_end")
+            return 1
+
+        async def fast_async(self):
+            self.events.append("async_start")
+            return 2
+
+        def get_events(self):
+            return list(self.events)
+
+    a = Ordered.remote()
+    r1 = a.slow_sync.remote()
+    r2 = a.fast_async.remote()
+    assert ray.get([r1, r2]) == [1, 2]
+    ev = ray.get(a.get_events.remote())
+    assert ev.index("async_start") > ev.index("sync_end"), (
+        f"async method started before queued sync method finished: {ev}"
+    )
+
+
+def test_async_actor_sync_methods_never_race(ray_start):
+    """Sync methods of an async actor must serialize (the reference
+    runs them on the one event loop) even though coroutines interleave
+    up to max_concurrency=1000 by default — a read-modify-write counter
+    must not lose updates."""
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            v = self.n
+            time.sleep(0.001)  # widen the race window
+            self.n = v + 1
+            return self.n
+
+        async def poke(self):
+            return "async"  # makes this an async actor
+
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(50)]
+    ray.get(refs)
+    assert ray.get(c.incr.remote()) == 51, "sync methods raced on self.n"
+
+
+def test_async_actor_defaults_concurrent(ray_start):
+    """No explicit max_concurrency: async-def methods must interleave
+    (reference defaults async actors to 1000)."""
+    import asyncio
+
+    @ray.remote
+    class Gate:
+        def __init__(self):
+            self.ev = asyncio.Event()
+
+        async def wait_open(self):
+            await self.ev.wait()
+            return "waited"
+
+        async def open(self):
+            self.ev.set()
+            return "opened"
+
+    g = Gate.remote()
+    r1 = g.wait_open.remote()
+    r2 = g.open.remote()  # must run while wait_open is parked
+    assert ray.get(r1, timeout=10) == "waited"
+    assert ray.get(r2, timeout=10) == "opened"
