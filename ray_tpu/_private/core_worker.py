@@ -21,6 +21,7 @@ import asyncio
 import collections
 import contextlib
 import hashlib
+import inspect
 import os
 import threading
 import time
@@ -169,6 +170,29 @@ def _confirmed_borrows(worker):
         scope.armed, scope.created = prev_armed, prev_count
         if created:
             worker._flush_borrows_now()
+
+
+class _BorrowCount:
+    __slots__ = ("created",)
+
+
+@contextlib.contextmanager
+def _counting_borrows():
+    """Arm the borrow scope WITHOUT flushing at exit: the caller decides
+    how to flush (async paths must await _flush_borrow_notifies on the
+    loop instead of the blocking _flush_borrows_now). The with-body must
+    contain no awaits — the scope is thread-local, and an interleaved
+    coroutine would otherwise account its borrows here."""
+    scope = _task_borrow_scope
+    out = _BorrowCount()
+    prev_armed = getattr(scope, "armed", False)
+    prev_count = getattr(scope, "created", 0)
+    scope.armed, scope.created = True, 0
+    try:
+        yield out
+    finally:
+        out.created = scope.created
+        scope.armed, scope.created = prev_armed, prev_count
 
 
 @contextlib.contextmanager
@@ -2344,38 +2368,25 @@ class CoreWorker:
             raise TypeError(
                 'num_returns="streaming" requires a generator function')
         task_id = TaskID(spec["task_id"])
-        owner = tuple(spec["owner_address"])
-        cli = self._pool.get(*owner)
+        cli = self._pool.get(*tuple(spec["owner_address"]))
         loop = EventLoopThread.get()
-        pending = []
-        buf: List[tuple] = []
-        last_send = time.monotonic()
-
-        def flush():
-            nonlocal buf, last_send
-            if not buf:
-                return
-            batch, buf = buf, []
-            last_send = time.monotonic()
-            pending.append(loop.spawn(cli.call(
-                "report_stream_items",
-                task_id=spec["task_id"],
-                items=batch,
-                node_id=self.node_id,
-            )))
+        batcher = _StreamReportBatcher(loop.spawn, cli, spec, self.node_id)
 
         def drain():
-            flush()
-            for fut in pending:
+            batcher.flush()
+            for fut in batcher.pending:
                 fut.result(timeout=60)
 
         try:
             for idx, value in enumerate(result):
-                buf.append((idx,
-                            self._pack_one_return(task_id, idx, value)))
-                # coalesce fast producers; slow ones ship per item
-                if len(buf) >= 32 or                         time.monotonic() - last_send >= 0.005:
-                    flush()
+                batcher.add((idx,
+                             self._pack_one_return(task_id, idx, value)))
+                if batcher.consumer_gone():
+                    # GeneratorExit inside the user generator: its
+                    # finally/with blocks run, and engine-backed
+                    # streams cancel their request
+                    result.close()
+                    break
         except Exception:
             # items yielded BEFORE the failure must land before the
             # error reply — __next__ drains buffered items first, and
@@ -2391,6 +2402,51 @@ class CoreWorker:
         drain()
         return {"returns": [], "node_id": self.node_id}
 
+    async def _stream_result_async(self, spec: dict, agen):
+        """Async-generator variant of _stream_result: pumps an async
+        generator actor method on the io loop, shipping items to the
+        owner as produced (reference supports async generator streaming
+        methods the same way, _raylet.pyx execute_streaming_generator_
+        async). Item packing is inline on the loop — streamed items are
+        typically small (tokens, chunks); large values still go to shm
+        via _pack_one_return. Borrow entries an item creates (nested
+        ObjectRefs pickled out-of-band) are flushed to their owners
+        BEFORE the item ships, mirroring _confirmed_borrows on the sync
+        paths — but awaited on the loop, since _flush_borrows_now would
+        deadlock here."""
+        task_id = TaskID(spec["task_id"])
+        cli = self._pool.get(*tuple(spec["owner_address"]))
+        batcher = _StreamReportBatcher(
+            asyncio.ensure_future, cli, spec, self.node_id)
+
+        async def drain():
+            batcher.flush()
+            for fut in batcher.pending:
+                await asyncio.wait_for(fut, timeout=60)
+
+        idx = 0
+        try:
+            async for value in agen:
+                with _counting_borrows() as borrows:
+                    packed = self._pack_one_return(task_id, idx, value)
+                if borrows.created:
+                    await self._flush_borrow_notifies()
+                batcher.add((idx, packed))
+                idx += 1
+                if batcher.consumer_gone():
+                    # GeneratorExit at the user generator's yield: its
+                    # finally blocks run, engine-backed streams cancel
+                    await agen.aclose()
+                    break
+        except Exception as e:  # noqa: BLE001 — ship error after items
+            try:
+                await drain()
+            except Exception:  # noqa: BLE001
+                pass
+            return self._actor_error_reply(spec, e)
+        await drain()
+        return {"returns": [], "node_id": self.node_id}
+
     async def _rpc_report_stream_items(self, task_id: bytes, items,
                                        node_id: str):
         """Owner service: install streamed generator items as owned
@@ -2401,7 +2457,9 @@ class CoreWorker:
         with self._records_lock:
             task = self._tasks.get(task_id)
             if task is None or task.stream is None:
-                return True
+                # consumer dropped the stream (generator GC / caller
+                # exit): tell the producer so it stops generating
+                return False
             fresh = {oid_bytes for _idx, (oid_bytes, _k, _p) in items
                      if oid_bytes not in self._records}
         if not fresh:
@@ -2417,7 +2475,7 @@ class CoreWorker:
         with self._records_lock:
             task = self._tasks.get(task_id)
             if task is None or task.stream is None:
-                return True
+                return False  # consumer dropped the stream mid-report
             stream = task.stream
             arrived = stream.setdefault("arrived", set())
             for idx, (oid_bytes, kind, payload) in items:
@@ -2515,15 +2573,16 @@ class CoreWorker:
         self.actor_instance = cls(*args, **kwargs)
         self.actor_id = actor_id
         self._max_concurrency = info.get("max_concurrency", 1)
-        # Async actor (any async-def method): max_concurrency bounds the
-        # number of INTERLEAVED coroutines, but sync methods serialize
-        # through the default lane — the reference runs them on the one
-        # event loop, where they block it, so two sync methods of an
-        # async actor never race each other's `self` mutations.
-        self._is_async_actor = any(
-            asyncio.iscoroutinefunction(getattr(self.actor_instance, n, None))
-            for n in dir(self.actor_instance) if not n.startswith("__")
-        )
+        # Async actor (any async-def or async-generator method):
+        # max_concurrency bounds the number of INTERLEAVED coroutines,
+        # but sync methods serialize through the default lane — the
+        # reference runs them on the one event loop, where they block
+        # it, so two sync methods of an async actor never race each
+        # other's `self` mutations. Inspect the CLASS with
+        # getattr_static: probing the live instance would execute
+        # property getters (side effects / non-AttributeError raises)
+        # during actor creation.
+        self._is_async_actor = _has_async_methods(cls)
         self._actor_executor = ThreadPoolExecutor(
             max_workers=1 if self._is_async_actor
             else self._max_concurrency
@@ -2621,8 +2680,9 @@ class CoreWorker:
                 spec, fut = entry
                 q.next_seq += 1
                 method = getattr(self.actor_instance, spec["method"], None)
-                is_async = method is not None and asyncio.iscoroutinefunction(
-                    method
+                is_async = method is not None and (
+                    asyncio.iscoroutinefunction(method)
+                    or inspect.isasyncgenfunction(method)
                 )
                 # group-routed methods run in their own lane: never
                 # serialize them into the default seq-ordered execution.
@@ -2722,8 +2782,8 @@ class CoreWorker:
         if spec.get("num_returns") == "streaming" and \
                 asyncio.iscoroutinefunction(method):
             return self._actor_error_reply(spec, TypeError(
-                'num_returns="streaming" supports sync generator '
-                "methods only"))
+                'num_returns="streaming" requires a generator or '
+                "async generator method (got a coroutine function)"))
         if method is None:
             return self._actor_error_reply(
                 spec,
@@ -2736,6 +2796,23 @@ class CoreWorker:
             return self._actor_error_reply(spec, ValueError(
                 f"concurrency group {group!r} not declared on this "
                 f"actor (has: {sorted(self._group_executors)})"))
+        if (spec.get("num_returns") == "streaming"
+                and inspect.isasyncgenfunction(method)):
+            # async generator streaming method: items pump on the io
+            # loop and ship to the owner as produced
+            try:
+                args, kwargs = await loop.run_in_executor(
+                    self._task_executor, self._unpack_args_confirmed, spec
+                )
+            except Exception as e:  # noqa: BLE001
+                return self._actor_error_reply(spec, e)
+            sem = self._group_semaphores.get(group) if group else None
+            if sem is not None:
+                async with sem:
+                    return await self._stream_result_async(
+                        spec, method(*args, **kwargs))
+            return await self._stream_result_async(
+                spec, method(*args, **kwargs))
         if asyncio.iscoroutinefunction(method):
             # arg refs may need network fetches — never block the io
             # loop resolving them (call_sync from the loop deadlocks)
@@ -3318,6 +3395,78 @@ class CoreWorker:
 # Lease pool: one per scheduling class (reference: NormalTaskSubmitter's
 # per-SchedulingKey lease management, normal_task_submitter.h:79)
 # ---------------------------------------------------------------------------
+class _StreamReportBatcher:
+    """Shared item-report batching for streaming generator execution
+    (sync executor threads and the async loop use the same protocol):
+    coalesce 32 items or 5 ms per report RPC, and detect a dropped
+    consumer — the owner answers False once its stream record is gone.
+    `spawn` turns the report coroutine into a future-like with
+    .done()/.result() (EventLoopThread.spawn or asyncio.ensure_future)."""
+
+    __slots__ = ("_spawn", "_cli", "_spec", "_node_id", "pending", "buf",
+                 "_last_send")
+
+    def __init__(self, spawn, cli, spec, node_id):
+        self._spawn = spawn
+        self._cli = cli
+        self._spec = spec
+        self._node_id = node_id
+        self.pending: collections.deque = collections.deque()
+        self.buf: List[tuple] = []
+        self._last_send = time.monotonic()
+
+    def add(self, item: tuple):
+        self.buf.append(item)
+        # coalesce fast producers; slow ones ship per item
+        if len(self.buf) >= 32 or \
+                time.monotonic() - self._last_send >= 0.005:
+            self.flush()
+
+    def flush(self):
+        if not self.buf:
+            return
+        batch, self.buf = self.buf, []
+        self._last_send = time.monotonic()
+        self.pending.append(self._spawn(self._cli.call(
+            "report_stream_items",
+            task_id=self._spec["task_id"],
+            items=batch,
+            node_id=self._node_id,
+        )))
+
+    def consumer_gone(self) -> bool:
+        """True once any completed report answered False (the owner
+        dropped the stream: client disconnect / generator GC) or the
+        owner is unreachable — the producer should stop."""
+        while self.pending and self.pending[0].done():
+            try:
+                if self.pending.popleft().result() is False:
+                    return True
+            except Exception:  # noqa: BLE001 — owner unreachable
+                return True
+        return False
+
+
+def _has_async_methods(cls) -> bool:
+    """True if the class defines any async-def or async-generator
+    method (the reference's is_async_func checks both). Uses
+    getattr_static so property getters and other descriptors are
+    inspected, never invoked."""
+    for name in dir(cls):
+        if name.startswith("__"):
+            continue
+        try:
+            static = inspect.getattr_static(cls, name)
+        except AttributeError:
+            continue
+        fn = static.__func__ if isinstance(
+            static, (staticmethod, classmethod)) else static
+        if asyncio.iscoroutinefunction(fn) or \
+                inspect.isasyncgenfunction(fn):
+            return True
+    return False
+
+
 class ObjectRefGenerator:
     """Iterator over a streaming task's return refs (reference:
     _raylet.pyx:288 ObjectRefGenerator — `num_returns="streaming"`
@@ -3360,9 +3509,12 @@ class ObjectRefGenerator:
                 w._ready_cv.wait(0.05)
         return ObjectRef(oid, w.address, _register=False)
 
-    def __del__(self):
-        # release the pre-bias of items never consumed, and drop the
-        # stream record so a half-read stream doesn't pin its tail
+    def close(self):
+        """Tear down the stream NOW (not at GC): releases the pre-bias
+        of items never consumed and drops the stream record — the
+        producer's next item report answers False and it stops
+        generating; a thread blocked in __next__ wakes and raises
+        StopIteration."""
         w = self._worker
         if w is None:
             return
@@ -3380,8 +3532,12 @@ class ObjectRefGenerator:
             for idx in range(self._next, count):
                 oid = ObjectID.for_task_return(self._task_id, idx)
                 w.remove_local_ref(oid)
+            w._notify_ready()  # wake blocked __next__ pollers
         except Exception:
             pass
+
+    def __del__(self):
+        self.close()
 
 
 class _LogTee:
@@ -3498,6 +3654,16 @@ class _LeasePool:
         self._last_grant_wait = 0.0
         self._backlog_id = f"{worker.worker_id}:{id(self):x}"
         self._backlog_reported = False
+        # Only plain CPU-demand DEFAULT pools reuse completed leases and
+        # batch tasks onto them: a pool holding scarce resources (TPU
+        # chips, custom resources) must lease per task, or two tasks
+        # that could run in PARALLEL on disjoint chip sets get
+        # serialized onto one worker's binding.
+        self._reuse_leases = (
+            strategy == "DEFAULT"
+            and not self.params
+            and all(k == "CPU" for k in (demand or {}))
+        )
 
     def enqueue(self, spec: dict):
         with self.lock:
@@ -3555,7 +3721,7 @@ class _LeasePool:
                     # in-batch task's result would deadlock waiting for
                     # a reply that cannot be sent yet.
                     batch = 1
-                    if self.strategy == "DEFAULT" and not self.params:
+                    if self._reuse_leases:
                         batch = max(1, self.worker._cfg.task_push_batch)
                         # leave work for the other free leases AND the
                         # leases already requested but not yet granted:
@@ -3909,11 +4075,28 @@ class _LeasePool:
             # SPREAD leases are single-use: reuse would pin the whole burst
             # to whichever node answered first (reference: spread policy
             # places per task, not per lease).
-            if self.queue and self.strategy != "SPREAD":
-                self.free_leases.append(lease)
-            else:
+            if self.strategy == "SPREAD" or (
+                not self._reuse_leases and not self.queue
+            ):
+                # SPREAD: single-use. Scarce-resource pools (see
+                # __init__) release their binding as soon as the queue
+                # drains — lingering would hold chips idle.
                 self.num_leases -= 1
                 asyncio.ensure_future(self._return_lease(lease, ok=True))
+            else:
+                # Keep the lease warm even when the queue is momentarily
+                # empty: a serial submit→get→submit driver hits exactly
+                # this state on every completion, and returning the
+                # lease here made each round-trip pay a fresh lease
+                # grant. The linger timer (not this path) decides when
+                # idle leases actually go back to the raylet.
+                self.free_leases.append(lease)
+                if not self.queue:
+                    self._idle_since = time.monotonic()
+                    if not self._linger_armed:
+                        self._linger_armed = True
+                        asyncio.get_running_loop().call_later(
+                            self.LEASE_LINGER_S, self._linger_expired)
         asyncio.ensure_future(self._pump())
 
     async def _return_lease(self, lease: dict, ok: bool):

@@ -71,9 +71,60 @@ def write_into(view: memoryview, meta: bytes, buffers: List[pickle.PickleBuffer]
         view[hdr_at : hdr_at + _HEADER.size] = _HEADER.pack(
             ((off - hdr_at - _HEADER.size) << 48) | raw.nbytes
         )
-        view[off : off + raw.nbytes] = raw.cast("B")
+        _copy_into(view, off, raw)
         off += raw.nbytes
     return off
+
+
+# PyMemoryView slice assignment neither releases the GIL nor uses the
+# widest vector moves — on large buffers it runs at ~half the machine's
+# memcpy bandwidth, and it serializes against the event-loop thread's
+# bookkeeping (ref frees) for the whole copy. Large copies go through the
+# native lib's shm_copy_fast (non-temporal stores, GIL released for the
+# ctypes call), falling back to numpy's copyto (real memcpy, drops the
+# GIL), then to the plain slice copy.
+_COPY_FAST_THRESHOLD = 1 << 20  # 1 MiB
+_fast_copy = None  # lazily resolved: (fn, ctypes) or False if unavailable
+
+
+def _resolve_fast_copy():
+    global _fast_copy
+    try:
+        import ctypes
+
+        from ..native.build import ensure_built
+
+        lib = ctypes.CDLL(ensure_built())
+        fn = lib.shm_copy_fast
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+        fn.restype = None
+        _fast_copy = (fn, ctypes)
+    except Exception:  # noqa: BLE001 — any failure: numpy/slice fallback
+        _fast_copy = False
+    return _fast_copy
+
+
+def _copy_into(view: memoryview, off: int, raw: memoryview) -> None:
+    n = raw.nbytes
+    if n >= _COPY_FAST_THRESHOLD:
+        fast = _fast_copy if _fast_copy is not None else _resolve_fast_copy()
+        try:
+            import numpy as np
+
+            src = np.frombuffer(raw.cast("B"), np.uint8)
+            if fast:
+                fn, ctypes = fast
+                dst_addr = ctypes.addressof(
+                    ctypes.c_char.from_buffer(view)) + off
+                fn(dst_addr, src.ctypes.data, n)
+            else:
+                np.copyto(
+                    np.frombuffer(view, np.uint8, count=n, offset=off), src
+                )
+            return
+        except (ImportError, ValueError, BufferError, TypeError):
+            pass  # fall through to the plain slice copy
+    view[off : off + n] = raw.cast("B")
 
 
 def dumps(obj: Any) -> bytes:

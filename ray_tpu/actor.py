@@ -137,21 +137,15 @@ def _public_methods(cls) -> Dict[str, Any]:
 
 
 def _default_max_concurrency(cls) -> int:
-    """Async actors (any async-def method) default to 1000 concurrent
-    in-flight methods, like the reference (python/ray/actor.py — async
-    actors get max_concurrency=1000 unless set); sync actors default to
-    1 (serialized). An explicit max_concurrency=1 on an async actor
-    serializes its methods through the default lane (see
-    core_worker._drain_caller_queue)."""
-    for name in dir(cls):
-        if name.startswith("__"):
-            continue
-        fn = inspect.getattr_static(cls, name, None)
-        if fn is not None and inspect.iscoroutinefunction(
-            getattr(cls, name, None)
-        ):
-            return 1000
-    return 1
+    """Async actors (any async-def or async-generator method) default
+    to 1000 concurrent in-flight methods, like the reference
+    (python/ray/actor.py — async actors get max_concurrency=1000 unless
+    set); sync actors default to 1 (serialized). An explicit
+    max_concurrency=1 on an async actor serializes its methods through
+    the default lane (see core_worker._drain_caller_queue)."""
+    from ._private.core_worker import _has_async_methods
+
+    return 1000 if _has_async_methods(cls) else 1
 
 
 def method(num_returns: int = 1, tensor_transport: Optional[str] = None,

@@ -82,9 +82,11 @@ class GenerationResult:
 
 class _Request:
     __slots__ = ("rid", "prompt", "params", "generated", "event", "result",
-                 "submit_time", "first_token_time", "prefilled", "done_cb")
+                 "submit_time", "first_token_time", "prefilled", "done_cb",
+                 "token_cb", "cancelled")
 
-    def __init__(self, rid, prompt, params, prefilled=None, done_cb=None):
+    def __init__(self, rid, prompt, params, prefilled=None, done_cb=None,
+                 token_cb=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.params = params
@@ -101,6 +103,22 @@ class _Request:
         # on the scheduler thread after `result` is set — no thread
         # blocked in event.wait() per in-flight request
         self.done_cb = done_cb
+        # per-token hook for streaming callers (astream): fires on the
+        # scheduler thread as each token folds into host state
+        self.token_cb = token_cb
+        # consumer abandoned the request (client disconnect): the
+        # scheduler frees the slot at the next tick instead of decoding
+        # the remaining budget for nobody
+        self.cancelled = False
+
+    def emit(self, tok: int):
+        """Append a decoded token and notify a streaming consumer."""
+        self.generated.append(tok)
+        if self.token_cb is not None:
+            try:
+                self.token_cb(self, tok)
+            except Exception:  # noqa: BLE001 — never kill the scheduler
+                pass
 
     def finish(self):
         self.event.set()
@@ -490,6 +508,55 @@ class LLMEngine:
         except asyncio.TimeoutError:
             raise TimeoutError(f"generation {req.rid} timed out")
 
+    async def astream(self, prompt_tokens: List[int],
+                      params: Optional[SamplingParams] = None,
+                      timeout: float = 300.0):
+        """Async generator over a request's tokens AS DECODED: yields
+        {"token": id} per token, then {"done": GenerationResult}. The
+        scheduler thread enqueues through call_soon_threadsafe; the
+        consumer observes TTFT = first yield, not time-to-last-token
+        (reference: vLLM AsyncLLMEngine.generate's async iterator)."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def _tok(req, tok):
+            loop.call_soon_threadsafe(q.put_nowait, ("tok", tok))
+
+        def _done(req):
+            loop.call_soon_threadsafe(q.put_nowait, ("done", req.result))
+
+        with self._rid_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = _Request(rid, prompt_tokens, params or SamplingParams(),
+                       done_cb=_done, token_cb=_tok)
+        if len(req.prompt) >= self.ecfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} >= max_seq_len "
+                f"{self.ecfg.max_seq_len}"
+            )
+        self._queue.put(req)
+        deadline = time.time() + timeout
+        try:
+            while True:
+                rem = deadline - time.time()
+                if rem <= 0:
+                    raise TimeoutError(f"generation {req.rid} timed out")
+                kind, val = await asyncio.wait_for(q.get(), rem)
+                if kind == "tok":
+                    yield {"token": int(val), "rid": req.rid}
+                else:
+                    yield {"done": val}
+                    return
+        finally:
+            # consumer stopped early (client disconnect closes the
+            # generator, or the wait timed out): tell the scheduler to
+            # free the slot instead of decoding the rest for nobody
+            if req.result is None:
+                req.cancelled = True
+
     def generate(self, prompt_tokens: List[int],
                  params: Optional[SamplingParams] = None,
                  timeout: float = 300.0) -> GenerationResult:
@@ -620,6 +687,7 @@ class LLMEngine:
         req.finish()
 
     def _loop_once(self, jnp):
+            self._reap_cancelled()
             admitted = self._admit()
             if self._dev_state is None:
                 # broken chain (host-sampled admission, single-step
@@ -699,7 +767,7 @@ class LLMEngine:
             for i in active:
                 req = self.slots[i]
                 tok = self._sample(logits_np[i], req.params)
-                req.generated.append(int(tok))
+                req.emit(int(tok))
                 if req.first_token_time is None:
                     req.first_token_time = now
                 self._maybe_finish(i)
@@ -795,7 +863,7 @@ class LLMEngine:
             arr = cache.get(id(toks_g))
             if arr is None:
                 arr = cache[id(toks_g)] = np.asarray(toks_g)
-            req.generated.append(int(arr[g]))
+            req.emit(int(arr[g]))
             self._maybe_finish(slot)
 
     def _consume_tick(self, packed_dev, active, chunk, pend=()):
@@ -818,7 +886,7 @@ class LLMEngine:
         for slot, req, _tok_dev in pend:
             if self.slots[slot] is not req:
                 continue
-            req.generated.append(int(firsts_np[slot]))
+            req.emit(int(firsts_np[slot]))
             self._maybe_finish(slot)
         now = time.time()
         for i, req in active:
@@ -826,7 +894,7 @@ class LLMEngine:
                 continue  # freed (or slot re-admitted) since dispatch
             consumed = 0
             for step in range(chunk):
-                req.generated.append(int(toks_np[step, i]))
+                req.emit(int(toks_np[step, i]))
                 consumed += 1
                 if req.first_token_time is None:
                     req.first_token_time = now
@@ -849,6 +917,14 @@ class LLMEngine:
                     req = self._queue.get_nowait()
                 except queue.Empty:
                     break
+            if req.cancelled:
+                # consumer gone before admission: never pay its prefill
+                req.result = GenerationResult(
+                    request_id=req.rid, prompt_tokens=req.prompt,
+                    token_ids=[], finish_reason="cancelled",
+                )
+                req.finish()
+                continue
             bucket = self._bucket(len(req.prompt))
             if self.paged and not self._reserve_pages(i, req, bucket):
                 ps = self.ecfg.page_size
@@ -896,7 +972,7 @@ class LLMEngine:
                             kv["v"][:, 0]),
                     }
                 self.lengths[i] = len(req.prompt)
-                req.generated.append(int(first_tok))
+                req.emit(int(first_tok))
                 req.first_token_time = req.first_token_time or time.time()
                 self.slots[i] = req
                 # disagg admissions bypass _finish_admissions: the
@@ -1034,7 +1110,7 @@ class LLMEngine:
                 if logits_np is None:
                     logits_np = np.asarray(last_logits)
                 tok = self._sample(logits_np[j], req.params)
-                req.generated.append(int(tok))
+                req.emit(int(tok))
                 self._dev_state = None  # host mirrors are authoritative
                 self._maybe_finish(i)
         if not dev_rows:
@@ -1102,6 +1178,29 @@ class LLMEngine:
              and req.generated[-1] in req.params.stop_token_ids)
             or len(req.generated) >= req.params.max_tokens
         )
+
+    def _reap_cancelled(self):
+        """Free slots whose consumer disconnected (request.cancelled):
+        continuing to decode them burns chip time for nobody. Runs at
+        tick start so an in-flight tick's tokens for the slot are
+        already folded or harmlessly discarded."""
+        for i, req in enumerate(self.slots):
+            if req is None or not req.cancelled:
+                continue
+            now = time.time()
+            req.result = GenerationResult(
+                request_id=req.rid,
+                prompt_tokens=req.prompt,
+                token_ids=list(req.generated),
+                finish_reason="cancelled",
+                ttft_s=(req.first_token_time or now) - req.submit_time,
+                latency_s=now - req.submit_time,
+            )
+            self.slots[i] = None
+            self.lengths[i] = 0
+            self._free_slot_pages(i)
+            self._tick_inputs_dirty = True
+            req.finish()
 
     def _maybe_finish(self, i: int):
         req = self.slots[i]

@@ -61,9 +61,7 @@ class LLMServer:
             return None
         return self.tokenizer.decode(token_ids)
 
-    async def __call__(self, payload: Dict[str, Any]) -> Dict[str, Any]:
-        """OpenAI-ish: supports /v1/completions-shaped payloads and chat
-        messages (flattened)."""
+    def _parse(self, payload: Dict[str, Any]):
         from .engine import SamplingParams
 
         if "messages" in payload:  # chat
@@ -85,6 +83,17 @@ class LLMServer:
             top_k=int(payload.get("top_k", 0)),
             stop_token_ids=tuple(payload.get("stop_token_ids", ())),
         )
+        return prompt, params
+
+    async def __call__(self, payload: Dict[str, Any]):
+        """OpenAI-ish: supports /v1/completions-shaped payloads and chat
+        messages (flattened). With "stream": true, returns an async
+        generator of OpenAI chunk dicts ending with "[DONE]" — the serve
+        proxy SSE-frames each item (reference: ray.serve.llm openai
+        streaming responses)."""
+        prompt, params = self._parse(payload)
+        if payload.get("stream"):
+            return self._stream_chunks(prompt, params)
         result = await self.engine.agenerate(prompt, params)
         text = self._decode_text(result.token_ids)
         choice: Dict[str, Any] = {
@@ -109,6 +118,73 @@ class LLMServer:
                 "latency_s": result.latency_s,
             },
         }
+
+    async def _stream_chunks(self, prompt, params):
+        """OpenAI streaming chunks: one per token, a final chunk with
+        finish_reason + usage, then the "[DONE]" sentinel."""
+        rid: Any = ""
+        toks: List[int] = []
+        emitted = 0  # chars of decoded text already streamed
+        async for ev in self.engine.astream(prompt, params):
+            if "token" in ev:
+                tok = ev["token"]
+                rid = ev.get("rid", rid)
+                toks.append(tok)
+                chunk: Dict[str, Any] = {
+                    "id": f"cmpl-{rid}",
+                    "object": "text_completion.chunk",
+                    "created": int(time.time()),
+                    "choices": [{
+                        "index": 0,
+                        "token_ids": [tok],
+                        "finish_reason": None,
+                    }],
+                }
+                if self.tokenizer is not None:
+                    # Incremental detokenization: decode the prefix so
+                    # far and emit only the NEW suffix, holding back
+                    # while the tail is an incomplete UTF-8 sequence —
+                    # a codepoint whose bytes span two BPE tokens must
+                    # never stream as replacement chars (vLLM's
+                    # incremental detokenizer does the same). Decoding
+                    # the full prefix per token is O(n²) in stream
+                    # length; acceptable at completion sizes, window it
+                    # if multi-thousand-token streams become the norm.
+                    text = self.tokenizer.decode(toks)
+                    if text.endswith("�"):
+                        chunk["choices"][0]["text"] = ""
+                    else:
+                        chunk["choices"][0]["text"] = text[emitted:]
+                        emitted = len(text)
+                yield chunk
+            else:
+                result = ev["done"]
+                final_choice: Dict[str, Any] = {
+                    "index": 0,
+                    "token_ids": [],
+                    "finish_reason": result.finish_reason,
+                }
+                if self.tokenizer is not None:
+                    # flush any text held back by the incomplete-UTF-8
+                    # guard above
+                    text = self.tokenizer.decode(result.token_ids)
+                    final_choice["text"] = text[emitted:]
+                yield {
+                    "id": f"cmpl-{result.request_id}",
+                    "object": "text_completion.chunk",
+                    "created": int(time.time()),
+                    "choices": [final_choice],
+                    "usage": {
+                        "prompt_tokens": len(prompt),
+                        "completion_tokens": len(result.token_ids),
+                        "total_tokens": len(prompt) + len(result.token_ids),
+                    },
+                    "metrics": {
+                        "ttft_s": result.ttft_s,
+                        "latency_s": result.latency_s,
+                    },
+                }
+        yield "[DONE]"
 
     def engine_stats(self) -> Dict[str, Any]:
         return self.engine.stats()

@@ -20,6 +20,10 @@
 // Exported C API (ctypes-friendly): shm_store_{open,close,create,seal,get,
 // release,contains,delete,evict,stats,list}.
 
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#endif
+
 #include <cerrno>
 #include <cstdint>
 #include <cstdlib>
@@ -380,9 +384,56 @@ uint64_t evict_lru(Store* s, uint64_t need) {
   return freed;
 }
 
+// Streaming (non-temporal) copy for large put payloads: a cached memcpy
+// pays read-for-ownership traffic on every destination line, halving the
+// effective write bandwidth into the arena. NT stores skip the RFO. Only
+// worth it past ~1 MiB (below that the data is about to be re-read from
+// cache anyway). Runtime-dispatched so the library loads on CPUs
+// without AVX2; non-x86 builds compile the plain-memcpy fallback only.
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("avx2"))) static void nt_copy_avx2(
+    uint8_t* d, const uint8_t* s, uint64_t n) {
+  uint64_t head = (32 - (reinterpret_cast<uintptr_t>(d) & 31)) & 31;
+  if (head > n) head = n;
+  memcpy(d, s, head);
+  d += head;
+  s += head;
+  n -= head;
+  uint64_t vec = n & ~static_cast<uint64_t>(127);
+  for (uint64_t i = 0; i < vec; i += 128) {
+    __m256i a = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s + i));
+    __m256i b =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s + i + 32));
+    __m256i c =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s + i + 64));
+    __m256i e =
+        _mm256_loadu_si256(reinterpret_cast<const __m256i*>(s + i + 96));
+    _mm256_stream_si256(reinterpret_cast<__m256i*>(d + i), a);
+    _mm256_stream_si256(reinterpret_cast<__m256i*>(d + i + 32), b);
+    _mm256_stream_si256(reinterpret_cast<__m256i*>(d + i + 64), c);
+    _mm256_stream_si256(reinterpret_cast<__m256i*>(d + i + 96), e);
+  }
+  _mm_sfence();
+  memcpy(d + vec, s + vec, n - vec);
+}
+#endif  // x86
+
 }  // namespace
 
 extern "C" {
+
+// GIL-free bulk copy (callers: serialization.write_into's out-of-band
+// buffer copies). Dispatches to NT stores when profitable and supported.
+void shm_copy_fast(void* dst, const void* src, uint64_t n) {
+#if defined(__x86_64__) || defined(__i386__)
+  if (n >= (1u << 20) && __builtin_cpu_supports("avx2")) {
+    nt_copy_avx2(reinterpret_cast<uint8_t*>(dst),
+                 reinterpret_cast<const uint8_t*>(src), n);
+    return;
+  }
+#endif
+  memcpy(dst, src, n);
+}
 
 // Opens (creating if needed) the arena file. Returns opaque handle or null.
 // The creator prefaults the whole arena (MAP_POPULATE) so puts never pay

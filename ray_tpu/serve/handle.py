@@ -135,12 +135,81 @@ class _ResponseFuture:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming handle call's items (reference:
+    serve/handle.py:510 DeploymentResponseGenerator — returned by
+    handle.options(stream=True).remote()). Each iteration yields the
+    next VALUE the deployment's generator produced, blocking until it
+    is available. Works as both a sync and an async iterator; the async
+    form hops the blocking wait to a thread so event-loop callers (the
+    HTTP proxy) can interleave many streams."""
+
+    def __init__(self, router: _Router, actor_id: str, ref_gen):
+        self._router = router
+        self._actor_id = actor_id
+        self._gen = ref_gen
+        self._done = False
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._router.track(self._actor_id, -1)
+
+    def close(self):
+        """Abandon the stream: releases router accounting and tears the
+        stream record down immediately — the replica's next item report
+        returns False and production stops (disconnect propagation). A
+        consumer blocked in __next__ on another thread is woken and
+        raises StopIteration."""
+        self._finish()
+        gen, self._gen = self._gen, None
+        if gen is not None:
+            gen.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu as ray
+
+        if self._gen is None:  # closed
+            raise StopIteration
+        try:
+            ref = next(self._gen)
+        except BaseException:
+            self._finish()
+            raise
+        return ray.get(ref)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            # StopIteration can't cross an executor future boundary —
+            # it arrives as RuntimeError; probe directly to be safe
+            raise StopAsyncIteration from None
+        except RuntimeError as e:
+            if "StopIteration" in str(e):
+                raise StopAsyncIteration from None
+            raise
+
+    def __del__(self):
+        self._finish()
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self.deployment_name = deployment_name
         self._method = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._router: Optional[_Router] = None
 
     def _get_router(self) -> _Router:
@@ -154,12 +223,13 @@ class DeploymentHandle:
         return self._router
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         out = DeploymentHandle(
             self.deployment_name, method_name or self._method,
             multiplexed_model_id
             if multiplexed_model_id is not None else self._model_id,
+            stream if stream is not None else self._stream,
         )
         # per-request .options() copies share the router: its in-flight
         # accounting and model map must not reset per call (creating it
@@ -172,12 +242,26 @@ class DeploymentHandle:
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment_name, name)
+        # method access preserves every other option (stream, model id)
+        # and shares the router, like .options(method_name=...)
+        out = DeploymentHandle(
+            self.deployment_name, name, self._model_id, self._stream)
+        out._router = self._router
+        return out
 
-    def remote(self, *args, **kwargs) -> _ResponseFuture:
+    def remote(self, *args, **kwargs):
         router = self._get_router()
         replica = router.choose(self._model_id)
         router.track(replica.actor_id, +1)
+        if self._stream:
+            ref_gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(
+                method=self._method, args=args, kwargs=kwargs,
+                multiplexed_model_id=self._model_id,
+            )
+            return DeploymentResponseGenerator(
+                router, replica.actor_id, ref_gen)
         ref = replica.handle_request.remote(
             method=self._method, args=args, kwargs=kwargs,
             multiplexed_model_id=self._model_id,
@@ -186,4 +270,5 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self._method, self._model_id))
+                (self.deployment_name, self._method, self._model_id,
+                 self._stream))

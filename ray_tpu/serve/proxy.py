@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import threading
 from typing import Any, Dict, Optional
 
@@ -103,6 +104,16 @@ class ProxyActor:
         self._started = threading.Event()
         self._num_requests = 0
         self._resolver = _AsyncResolver()
+        # streaming waits block a thread per in-flight SSE stream (the
+        # item wait is a condvar poll); a dedicated wide pool keeps
+        # stream concurrency off the loop's tiny default executor
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._stream_executor = ThreadPoolExecutor(
+            max_workers=int(
+                os.environ.get("RAY_TPU_SERVE_MAX_STREAMS", "256")),
+            thread_name_prefix="serve-sse",
+        )
         from .._private.rpc import EventLoopThread
 
         self._loop = EventLoopThread.get().loop
@@ -170,6 +181,15 @@ class ProxyActor:
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
 
+        # streaming request (OpenAI-style "stream": true, or an
+        # Accept: text/event-stream client): run the deployment's
+        # generator through a streaming handle and SSE-frame each item
+        # (reference: proxy.py streaming ASGI path + SSE responses)
+        wants_stream = (
+            isinstance(payload, dict) and bool(payload.get("stream"))
+        ) or "text/event-stream" in request.headers.get("Accept", "")
+        if wants_stream:
+            return await self._handle_streaming(request, handle, payload)
         try:
             # submission (routing + one actor push, may briefly block on
             # a controller refresh) hops through the pool for
@@ -189,3 +209,79 @@ class ProxyActor:
         if isinstance(result, str):
             return web.Response(text=result)
         return web.json_response(result)
+
+    async def _handle_streaming(self, request, handle, payload):
+        """Server-sent events: one `data:` frame per item the
+        deployment's generator yields, flushed as produced — the client
+        observes TTFT, not time-to-last-token."""
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        try:
+            gen = await loop.run_in_executor(
+                self._stream_executor,
+                lambda: handle.options(stream=True).remote(payload))
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=500)
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        sentinel = object()
+
+        def _next():
+            try:
+                return next(gen)
+            except StopIteration:
+                return sentinel
+
+        client_gone = False
+        try:
+            while True:
+                item = await loop.run_in_executor(
+                    self._stream_executor, _next)
+                if item is sentinel:
+                    break
+                if isinstance(item, bytes):
+                    frame = item.decode(errors="replace")
+                elif isinstance(item, str):
+                    frame = item
+                else:
+                    frame = json.dumps(item)
+                try:
+                    await resp.write(f"data: {frame}\n\n".encode())
+                except (ConnectionError, OSError, RuntimeError):
+                    # client hung up mid-stream: stop reading and let
+                    # the generator teardown below cancel production
+                    client_gone = True
+                    break
+        except Exception as e:  # noqa: BLE001 — upstream failure
+            if not client_gone:
+                try:
+                    await resp.write(
+                        f"data: {json.dumps({'error': str(e)})}\n\n"
+                        .encode())
+                except (ConnectionError, OSError, RuntimeError):
+                    client_gone = True
+        finally:
+            # close() drops the underlying ref generator: the stream
+            # record on this owner tears down, the replica's next item
+            # report comes back False, and the producer stops (engine
+            # requests cancel) — a disconnected client stops burning
+            # decode time
+            try:
+                gen.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if not client_gone:
+            try:
+                await resp.write_eof()
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+        return resp

@@ -61,6 +61,54 @@ class ReplicaActor:
             with self._lock:
                 self._ongoing -= 1
 
+    async def handle_request_streaming(self, method: str, args: tuple,
+                                       kwargs: dict,
+                                       multiplexed_model_id: str = ""):
+        """Streaming variant (reference: replica.py handle_request_
+        streaming → UserCallableWrapper.call_user_generator): the user
+        method is a sync/async generator (or returns one); items are
+        re-yielded through the actor streaming protocol
+        (num_returns="streaming" on the caller side), so the handle's
+        response generator sees tokens as they are produced. A
+        non-generator result streams as a single item."""
+        from .multiplex import _set_model_id
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        _set_model_id(multiplexed_model_id)
+        try:
+            target = getattr(self.user, method)
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            if inspect.isasyncgen(result):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result):
+                # sync generator: step it off-loop so a slow producer
+                # doesn't block the replica's event loop between items
+                loop = asyncio.get_running_loop()
+                sentinel = object()
+
+                def _next():
+                    try:
+                        return next(result)
+                    except StopIteration:
+                        return sentinel
+
+                while True:
+                    item = await loop.run_in_executor(
+                        self._executor, _next)
+                    if item is sentinel:
+                        break
+                    yield item
+            else:
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def get_stats(self) -> Dict[str, Any]:
         from .multiplex import loaded_model_ids
 

@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--prompt-len", type=int, default=64)
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--output", default="BENCH_serve.json")
+    ap.add_argument("--stream", type=int, default=1,
+                    help="1 = measure client-observed TTFT via SSE "
+                         "streaming requests; 0 = non-streaming JSON")
     args = ap.parse_args()
 
     import urllib.request
@@ -107,10 +110,47 @@ def main():
     sem = threading.Semaphore(args.concurrency)
     errors = []
 
+    stream_payload = None
+    if args.stream:
+        sp = json.loads(payload)
+        sp["stream"] = True
+        stream_payload = json.dumps(sp).encode()
+
     def one(i):
         with sem:
             t0 = time.perf_counter()
             try:
+                if args.stream:
+                    # CLIENT-OBSERVED TTFT: wall-clock to the first SSE
+                    # token chunk, through the whole data plane — the
+                    # number a real streaming client experiences
+                    # (VERDICT r4 #2), not the engine's internal stamp.
+                    ttft = None
+                    ntok = 0
+                    with urllib.request.urlopen(
+                        urllib.request.Request(
+                            url, data=stream_payload,
+                            headers={"Content-Type": "application/json"}),
+                        timeout=600,
+                    ) as resp:
+                        for raw in resp:
+                            line = raw.decode().strip()
+                            if not line.startswith("data:"):
+                                continue
+                            frame = line[5:].strip()
+                            if frame == "[DONE]":
+                                continue
+                            body = json.loads(frame)
+                            if body.get("choices", [{}])[0].get(
+                                    "token_ids"):
+                                ntok += len(body["choices"][0]["token_ids"])
+                                if ttft is None:
+                                    ttft = time.perf_counter() - t0
+                    wall = time.perf_counter() - t0
+                    with lock:
+                        results.append((wall, ttft if ttft is not None
+                                        else wall, ntok))
+                    return
                 resp = urllib.request.urlopen(
                     urllib.request.Request(
                         url, data=payload,
@@ -156,6 +196,7 @@ def main():
     out = {
         "requests": len(results),
         "errors": len(errors),
+        "stream": bool(args.stream),
         "loop_errors": loop_errors,
         "concurrency": args.concurrency,
         "prompt_len": args.prompt_len,

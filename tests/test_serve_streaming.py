@@ -1,0 +1,253 @@
+"""Token-streaming through the serve data plane (VERDICT r4 missing #1).
+
+Covers: handle.options(stream=True) returning a response generator
+(reference: serve/handle.py:510 DeploymentResponseGenerator), SSE
+(text/event-stream) through the HTTP proxy with incremental delivery,
+and the OpenAI-style "stream": true path on the LLM app.
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_start():
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    yield
+    serve.shutdown()
+    ray.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup(serve_start):
+    yield
+    import time as _t
+
+    try:
+        for name in list(serve.status()["deployments"]):
+            serve.delete(name)
+        deadline = _t.time() + 60
+        while _t.time() < deadline and any(
+            d["num_replicas"] > 0
+            for d in serve.status()["deployments"].values()
+        ):
+            _t.sleep(0.3)
+    except Exception:
+        pass
+
+
+def _sync_streamer_cls():
+    class SyncStreamer:
+        def __call__(self, payload):
+            n = int(payload.get("n", 4)) if isinstance(payload, dict) else 4
+            for i in range(n):
+                yield {"i": i}
+
+    return SyncStreamer
+
+
+def _async_streamer_cls():
+    class AsyncStreamer:
+        async def __call__(self, payload):
+            import asyncio
+
+            n = int(payload.get("n", 4)) if isinstance(payload, dict) else 4
+            for i in range(n):
+                await asyncio.sleep(float(payload.get("delay", 0)))
+                yield {"i": i}
+
+        async def agen(self, n):
+            for i in range(n):
+                yield i * 10
+
+    return AsyncStreamer
+
+
+def test_handle_stream_sync_generator(serve_start):
+    handle = serve.run(
+        serve.deployment(_sync_streamer_cls()).bind(), _http=False)
+    gen = handle.options(stream=True).remote({"n": 5})
+    assert [item["i"] for item in gen] == [0, 1, 2, 3, 4]
+
+
+def test_handle_stream_async_generator_method(serve_start):
+    handle = serve.run(serve.deployment(_async_streamer_cls()).bind(), _http=False)
+    gen = handle.options(stream=True, method_name="agen").remote(3)
+    assert list(gen) == [0, 10, 20]
+    # non-generator method through the streaming path: single item
+    gen2 = handle.options(stream=True).remote({"n": 2})
+    assert [item["i"] for item in gen2] == [0, 1]
+
+
+def test_llm_openai_stream_true(serve_start):
+    """OpenAI `stream: true` end-to-end: per-token chunks over SSE,
+    finish chunk with usage, then [DONE] (reference: ray.serve.llm
+    openai streaming)."""
+    from ray_tpu.llm import build_openai_app
+
+    app = build_openai_app(
+        model_config={"preset": "tiny", "max_seq_len": 128},
+        engine_config={"max_batch_size": 2, "max_seq_len": 128,
+                       "prefill_buckets": (16, 32)},
+    )
+    serve.run(app, http_port=18662, route_prefix="/v1")
+    req = urllib.request.Request(
+        "http://127.0.0.1:18662/v1/completions",
+        data=json.dumps({"prompt": [1, 2, 3], "max_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    frames = []
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data:"):
+                frames.append(line[5:].strip())
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    token_chunks = [c for c in chunks if c["choices"][0]["token_ids"]]
+    assert len(token_chunks) == 6, frames
+    final = chunks[-1]
+    assert final["choices"][0]["finish_reason"] == "length"
+    assert final["usage"]["completion_tokens"] == 6
+
+
+def test_engine_astream_direct(serve_start):
+    """Engine-level async token stream: tokens arrive one at a time,
+    then a done event carrying the final result."""
+    import asyncio
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+    from ray_tpu.models.llama import LlamaConfig
+
+    eng = LLMEngine(
+        LlamaConfig.tiny(max_seq_len=128),
+        engine_config=EngineConfig(max_batch_size=2, max_seq_len=128,
+                                   prefill_buckets=(16, 32)),
+    )
+    try:
+        async def collect():
+            toks, done = [], None
+            async for ev in eng.astream([1, 2, 3],
+                                        SamplingParams(max_tokens=5)):
+                if "token" in ev:
+                    toks.append(ev["token"])
+                else:
+                    done = ev["done"]
+            return toks, done
+
+        toks, done = asyncio.run(collect())
+        assert len(toks) == 5
+        assert done is not None and done.token_ids == toks
+        assert done.finish_reason == "length"
+    finally:
+        eng.shutdown()
+
+
+def test_disconnect_cancels_engine_request(serve_start):
+    """Abandoning a streaming response mid-generation must cancel the
+    engine request: the scheduler frees the slot instead of decoding
+    the remaining budget for nobody (reference: serve cancels on client
+    disconnect)."""
+    import asyncio
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+    from ray_tpu.models.llama import LlamaConfig
+
+    eng = LLMEngine(
+        LlamaConfig.tiny(max_seq_len=128),
+        engine_config=EngineConfig(max_batch_size=2, max_seq_len=128,
+                                   prefill_buckets=(16, 32)),
+    )
+    try:
+        async def take_two():
+            agen = eng.astream([1, 2, 3], SamplingParams(max_tokens=100))
+            toks = []
+            async for ev in agen:
+                if "token" in ev:
+                    toks.append(ev["token"])
+                if len(toks) >= 2:
+                    await agen.aclose()  # client disconnected
+                    break
+            return toks
+
+        toks = asyncio.run(take_two())
+        assert len(toks) == 2
+        # the slot must free well before the 100-token budget would
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if eng.stats()["active"] == 0:
+                break
+            time.sleep(0.05)
+        assert eng.stats()["active"] == 0, "cancelled request kept its slot"
+    finally:
+        eng.shutdown()
+
+
+def test_handle_stream_close_stops_producer(serve_start):
+    """Closing a DeploymentResponseGenerator mid-stream propagates to
+    the replica: its item reports come back False and the producer's
+    generator is closed instead of running to completion."""
+    def _slow_counter_cls():
+        class SlowCounter:
+            def __init__(self):
+                self.produced = 0
+
+            def __call__(self, payload):
+                import time as _t
+
+                for i in range(200):
+                    self.produced += 1
+                    _t.sleep(0.02)
+                    yield i
+
+            def count(self):
+                return self.produced
+
+        return SlowCounter
+
+    handle = serve.run(
+        serve.deployment(_slow_counter_cls()).bind(), _http=False)
+    gen = handle.options(stream=True).remote({})
+    got = [next(gen) for _ in range(3)]
+    assert got == [0, 1, 2]
+    gen.close()
+    time.sleep(3.0)  # give the producer time to notice and stop
+    produced = handle.options(method_name="count").remote().result(60)
+    assert produced < 150, (
+        f"producer generated {produced}/200 items after close"
+    )
+
+
+def test_http_sse_incremental(serve_start):
+    """Items must arrive INCREMENTALLY over SSE: with a per-item delay,
+    the gap between first and last chunk must reflect production time,
+    i.e. the client sees the first token before the stream finishes."""
+    serve.run(serve.deployment(_async_streamer_cls()).bind(), http_port=18662)
+    req = urllib.request.Request(
+        "http://127.0.0.1:18662/",
+        data=json.dumps({"n": 5, "delay": 0.15, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.monotonic()
+    arrive = []
+    items = []
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data:"):
+                continue
+            arrive.append(time.monotonic() - t0)
+            items.append(json.loads(line[5:].strip()))
+    assert [it["i"] for it in items] == [0, 1, 2, 3, 4]
+    # incremental: first item lands well before the last (0.6s of
+    # production time after it); a buffered-at-once response would give
+    # a near-zero spread
+    assert arrive[-1] - arrive[0] > 0.3, arrive
