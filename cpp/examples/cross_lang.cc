@@ -38,6 +38,37 @@ static int ServeMode(const char* host, int port) {
   server.Register("cpp_fail", [](const std::string&) -> std::string {
     throw std::runtime_error("native failure for the test");
   });
+  // Stateful actor class (reference: RAY_REMOTE actor classes,
+  // cpp/include/ray/api/actor_handle.h): a counter whose per-instance
+  // state Python drives through ordered method calls.
+  class Counter : public ray_tpu::CppActor {
+   public:
+    explicit Counter(int64_t start) : value_(start) {}
+    std::string Call(const std::string& method,
+                     const std::string& payload) override {
+      if (method == "add") {
+        unsigned char b =
+            payload.empty() ? 1 : static_cast<unsigned char>(payload[0]);
+        value_ += b;
+        // order-sensitive digest: any reordering of add() calls
+        // changes it, so the test can assert ordered execution
+        digest_ = digest_ * 1000003ULL + b;
+        return std::to_string(value_);
+      }
+      if (method == "get") return std::to_string(value_);
+      if (method == "digest") return std::to_string(digest_);
+      throw std::runtime_error("Counter has no method " + method);
+    }
+
+   private:
+    int64_t value_;
+    uint64_t digest_ = 0;
+  };
+  server.RegisterActorClass(
+      "Counter", [](const std::string& init) {
+        int64_t start = init.empty() ? 0 : std::stoll(init);
+        return std::unique_ptr<ray_tpu::CppActor>(new Counter(start));
+      });
   int bound = server.Listen("127.0.0.1", 0);
   ray_tpu::ClientSession sess(host, port);
   sess.RegisterCppWorker(server.FunctionNames(), "127.0.0.1", bound);

@@ -23,6 +23,7 @@
 #include <cstring>
 #include <functional>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <stdexcept>
 #include <string>
@@ -194,17 +195,43 @@ class ClientSession {
 // cross_language.cpp_function descriptors) can push invocations with
 // the standard RpcClient pool.
 // ---------------------------------------------------------------------------
+// Base class for C++-hosted actors (reference:
+// cpp/include/ray/api/actor_handle.h + actor_creator.h — RAY_REMOTE
+// actor classes instantiated and driven by the runtime). Subclasses
+// dispatch by method name; per-instance state lives in the object, and
+// the TaskServer executes an instance's methods one at a time in
+// arrival order (Python's actor machinery provides the per-caller
+// submission ordering, like any other actor).
+class CppActor {
+ public:
+  virtual ~CppActor() = default;
+  // method name + payload bytes in, reply bytes out
+  virtual std::string Call(const std::string& method,
+                           const std::string& payload) = 0;
+};
+
 class TaskServer {
  public:
   using Fn = std::function<std::string(const std::string&)>;
+  using ActorFactory =
+      std::function<std::unique_ptr<CppActor>(const std::string&)>;
 
   void Register(const std::string& name, Fn fn) {
     fns_[name] = std::move(fn);
   }
 
+  // Register an actor CLASS: Python creates instances by descriptor
+  // ("actor:<name>") with an init payload; the factory returns the
+  // instance this server then hosts.
+  void RegisterActorClass(const std::string& name, ActorFactory factory) {
+    actor_factories_[name] = std::move(factory);
+  }
+
   ValueList FunctionNames() const {
     ValueList out;
     for (const auto& [name, _fn] : fns_) out.push_back(Value(name));
+    for (const auto& [name, _f] : actor_factories_)
+      out.push_back(Value("actor:" + name));
     return out;
   }
 
@@ -275,6 +302,59 @@ class TaskServer {
                   seq, 1, Value(std::string("RuntimeError: ") + e.what()));
             }
           }
+        } else if (method == "create_cpp_actor") {
+          const ValueDict& kw = tup.at(2).as_dict();
+          const std::string& cls = kw.at("cls").as_str();
+          const std::string& actor_id = kw.at("actor_id").as_str();
+          auto it = actor_factories_.find(cls);
+          if (it == actor_factories_.end()) {
+            reply = pickle::EncodeReply(
+                seq, 1, Value("KeyError: no C++ actor class " + cls));
+          } else {
+            try {
+              auto inst = it->second(kw.at("payload").as_bytes());
+              {
+                std::lock_guard<std::mutex> lock(actors_mu_);
+                actors_[actor_id] =
+                    std::make_shared<ActorSlot>(std::move(inst));
+              }
+              reply = pickle::EncodeReply(seq, 0, Value(true));
+            } catch (const std::exception& e) {
+              reply = pickle::EncodeReply(
+                  seq, 1, Value(std::string("RuntimeError: ") + e.what()));
+            }
+          }
+        } else if (method == "invoke_cpp_actor") {
+          const ValueDict& kw = tup.at(2).as_dict();
+          const std::string& actor_id = kw.at("actor_id").as_str();
+          std::shared_ptr<ActorSlot> slot;
+          {
+            std::lock_guard<std::mutex> lock(actors_mu_);
+            auto it = actors_.find(actor_id);
+            if (it != actors_.end()) slot = it->second;
+          }
+          if (!slot) {
+            reply = pickle::EncodeReply(
+                seq, 1, Value("KeyError: no C++ actor " + actor_id));
+          } else {
+            try {
+              // per-instance serialization: methods of one actor run
+              // one at a time, in arrival order
+              std::lock_guard<std::mutex> lock(slot->mu);
+              std::string out = slot->actor->Call(
+                  kw.at("actor_method").as_str(), kw.at("payload").as_bytes());
+              reply = pickle::EncodeReply(seq, 0,
+                                          Value::Bytes(std::move(out)));
+            } catch (const std::exception& e) {
+              reply = pickle::EncodeReply(
+                  seq, 1, Value(std::string("RuntimeError: ") + e.what()));
+            }
+          }
+        } else if (method == "destroy_cpp_actor") {
+          const ValueDict& kw = tup.at(2).as_dict();
+          std::lock_guard<std::mutex> lock(actors_mu_);
+          actors_.erase(kw.at("actor_id").as_str());
+          reply = pickle::EncodeReply(seq, 0, Value(true));
         } else {
           reply = pickle::EncodeReply(seq, 1,
                                       Value("no such method: " + method));
@@ -309,7 +389,16 @@ class TaskServer {
     return true;
   }
 
+  struct ActorSlot {
+    explicit ActorSlot(std::unique_ptr<CppActor> a) : actor(std::move(a)) {}
+    std::unique_ptr<CppActor> actor;
+    std::mutex mu;  // serializes this instance's methods
+  };
+
   std::map<std::string, Fn> fns_;
+  std::map<std::string, ActorFactory> actor_factories_;
+  std::map<std::string, std::shared_ptr<ActorSlot>> actors_;
+  std::mutex actors_mu_;
   int listen_fd_ = -1;
 };
 

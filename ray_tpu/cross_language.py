@@ -141,3 +141,117 @@ def cpp_function(name: str):
             return f"CppFunction({self._name!r})"
 
     return _CppFunction(name)
+
+
+# ---------------------------------------------------------------------------
+# C++ ACTORS: stateful native instances hosted by a C++ TaskServer
+# (reference: cpp/include/ray/api/actor_handle.h, actor_creator.h —
+# RAY_REMOTE actor classes created and called through handles; runtime
+# in cpp/src/ray/runtime/task/task_executor.cc). Here creation and every
+# method call ride a PYTHON proxy actor pinned to the C++ worker's node:
+# the proxy gives the standard actor guarantees (per-caller submission
+# ordering, restarts, named handles) while execution is native — the
+# C++ server runs one method of an instance at a time under its lock.
+# ---------------------------------------------------------------------------
+
+
+_cpp_proxy_cls = None
+
+
+def _get_cpp_proxy_cls():
+    global _cpp_proxy_cls
+    if _cpp_proxy_cls is None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        class _CppActorProxy:
+            def __init__(self, cls_name: str, init_payload: bytes,
+                         timeout_s: float = 60.0):
+                import uuid
+
+                from ray_tpu._private.core_worker import global_worker
+                from ray_tpu.cross_language import _resolve_cpp_worker
+
+                self._host, self._port, _ = _resolve_cpp_worker(
+                    "actor:" + cls_name)
+                self._aid = uuid.uuid4().hex
+                self._timeout = float(timeout_s)
+                w = global_worker()
+                w._pool.get(self._host, self._port).call_sync(
+                    "create_cpp_actor", cls=cls_name, actor_id=self._aid,
+                    payload=bytes(init_payload), timeout=self._timeout)
+
+            def call(self, method: str, payload: bytes = b"",
+                     timeout_s=None) -> bytes:
+                from ray_tpu._private.core_worker import global_worker
+
+                w = global_worker()
+                out = w._pool.get(self._host, self._port).call_sync(
+                    "invoke_cpp_actor", actor_id=self._aid,
+                    actor_method=str(method), payload=bytes(payload),
+                    timeout=float(timeout_s or self._timeout))
+                return bytes(out)
+
+            def destroy(self) -> bool:
+                from ray_tpu._private.core_worker import global_worker
+
+                w = global_worker()
+                w._pool.get(self._host, self._port).call_sync(
+                    "destroy_cpp_actor", actor_id=self._aid,
+                    timeout=self._timeout)
+                return True
+
+        _cpp_proxy_cls = _CppActorProxy
+    return _cpp_proxy_cls
+
+
+class CppActorHandle:
+    """Handle to a C++-hosted actor instance. ``call(method, payload)``
+    returns an ObjectRef[bytes]; calls from one handle execute in
+    submission order (proxy actor max_concurrency=1 + per-instance lock
+    on the C++ side)."""
+
+    def __init__(self, proxy):
+        self._proxy = proxy
+
+    def call(self, method: str, payload: bytes = b"", timeout_s=None):
+        return self._proxy.call.remote(method, payload, timeout_s)
+
+    def destroy(self):
+        import ray_tpu
+
+        ray_tpu.get(self._proxy.destroy.remote(), timeout=60)
+        ray_tpu.kill(self._proxy)
+
+
+def cpp_actor_class(cls_name: str):
+    """Factory for C++ actor instances: ``cpp_actor_class("Counter")
+    .remote(init_payload)`` creates the native instance on the node
+    whose TaskServer registered the class, and returns a
+    :class:`CppActorHandle`."""
+
+    class _CppActorClass:
+        @staticmethod
+        def remote(init_payload: bytes = b"",
+                   timeout_s: float = 60.0) -> CppActorHandle:
+            """``timeout_s``: default RPC timeout for create/call/destroy
+            (long-running native methods should raise it; per-call
+            override via ``handle.call(..., timeout_s=...)``)."""
+            _h, _p, node_id = _resolve_cpp_worker("actor:" + cls_name)
+            proxy_cls = _get_cpp_proxy_cls()
+            opts = {"max_concurrency": 1}
+            if node_id:
+                from .util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy,
+                )
+
+                opts["scheduling_strategy"] = (
+                    NodeAffinitySchedulingStrategy(node_id))
+            proxy = proxy_cls.options(**opts).remote(
+                cls_name, bytes(init_payload), timeout_s)
+            return CppActorHandle(proxy)
+
+        def __repr__(self):
+            return f"CppActorClass({cls_name!r})"
+
+    return _CppActorClass()

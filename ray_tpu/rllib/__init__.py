@@ -21,13 +21,16 @@ from .learner import Learner, LearnerGroup
 from .config import AlgorithmConfig
 from .algorithm import Algorithm
 from .algorithms import (PPO, PPOConfig, DQN, DQNConfig, SAC,
-                         SACConfig, IMPALA, IMPALAConfig)
+                         SACConfig, IMPALA, IMPALAConfig,
+                         BC, BCConfig, MARWIL, MARWILConfig)
+from . import offline
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                           MultiAgentPPO, IndependentCartPoles)
 
 __all__ = [
     "Box", "Discrete", "Env", "VectorEnv", "register_env", "make_env",
     "SampleBatch", "ActorCriticModule", "QModule", "EnvRunner",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig", "offline",
     "Learner", "LearnerGroup", "AlgorithmConfig", "Algorithm",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
     "IMPALA", "IMPALAConfig", "MultiAgentEnv", "MultiAgentEnvRunner",

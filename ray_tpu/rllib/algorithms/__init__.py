@@ -2,6 +2,7 @@ from .ppo import PPO, PPOConfig
 from .dqn import DQN, DQNConfig
 from .sac import SAC, SACConfig
 from .impala import IMPALA, IMPALAConfig
+from .marwil import BC, BCConfig, MARWIL, MARWILConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
-           "IMPALA", "IMPALAConfig"]
+           "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig"]

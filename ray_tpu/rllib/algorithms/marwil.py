@@ -1,0 +1,198 @@
+"""MARWIL + BC: offline policy learning from logged episodes.
+
+Reference: rllib/algorithms/marwil/marwil.py (MARWILConfig — beta,
+moving-average advantage normalizer) + marwil/torch/
+marwil_torch_learner.py (the exponentially-weighted imitation loss),
+and rllib/algorithms/bc/bc.py (BC = MARWIL with beta = 0: pure
+behavior cloning). TPU-first: one jitted update does the value
+regression, advantage exponentiation, and policy step; the c² moving
+average is carried as learner state through the jit boundary.
+
+Training consumes ONLY logged data (offline.DatasetReader) — no env
+interaction; an env is still constructed for spaces and evaluation.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..algorithm import Algorithm
+from ..config import AlgorithmConfig
+from ..env import make_env
+from ..learner import Learner
+from ..offline import RETURNS, DatasetReader
+from ..rl_module import ActorCriticModule
+from ..sample_batch import ACTIONS, OBS, SampleBatch
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        # beta = 0 -> plain behavior cloning (the reference's BC)
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        self.moving_average_sqd_adv_norm_update_rate = 1e-7
+        self.input_ = None  # path(s) to logged episode files
+        self.lr = 1e-3
+
+    @property
+    def algo_class(self):
+        return MARWIL
+
+    def offline_data(self, input_=None):
+        """Chained setter naming the logged-episode files (reference:
+        AlgorithmConfig.offline_data(input_=...))."""
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+
+class MARWILLearner(Learner):
+    """One jitted update: value regression on reward-to-go, advantage
+    A = R - V(s), policy loss −exp(β·A / c)·logπ(a|s) with c the
+    running sqrt of E[A²] (the reference's squared-advantage moving
+    average, which keeps the exponent scale-free)."""
+
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        # moving average of E[A^2]; learner state like params/opt_state
+        self.ma_sqd_adv = jnp.asarray(100.0)
+        self._update_jit = jax.jit(partial(
+            self._update_impl,
+            beta=config.get("beta", 1.0),
+            vf_coeff=config.get("vf_coeff", 1.0),
+            ma_rate=config.get(
+                "moving_average_sqd_adv_norm_update_rate", 1e-7),
+        ))
+
+    def _update_impl(self, params, opt_state, ma_sqd_adv, batch, *,
+                     beta, vf_coeff, ma_rate):
+        obs = batch[OBS]
+        actions = batch[ACTIONS]
+        returns = batch[RETURNS]
+
+        def loss_fn(p):
+            values = self.module.value(p, obs)
+            adv = returns - values
+            vf_loss = jnp.mean(adv ** 2)
+            logp = self.module.logp(p, obs, actions)
+            if beta == 0.0:
+                # BC: pure negative log-likelihood of the logged action
+                weights = jnp.ones_like(logp)
+            else:
+                # stop-grad: the normalizer and the exp weight are
+                # targets, not differentiated paths (reference:
+                # marwil_torch_learner.py possibly_masked_mean of
+                # exp(beta * adv / c) * logp with detached adv)
+                c = jnp.sqrt(ma_sqd_adv + 1e-8)
+                weights = jnp.exp(
+                    beta * jax.lax.stop_gradient(adv) / c)
+                weights = jnp.clip(weights, 0.0, 20.0)
+            pi_loss = -jnp.mean(weights * logp)
+            total = pi_loss + vf_coeff * vf_loss
+            return total, (pi_loss, vf_loss, jnp.mean(adv ** 2))
+
+        (loss, (pi_loss, vf_loss, sqd_adv)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ma_sqd_adv = ma_sqd_adv + ma_rate * (sqd_adv - ma_sqd_adv)
+        return params, opt_state, ma_sqd_adv, {
+            "total_loss": loss,
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "moving_avg_sqd_adv_norm": ma_sqd_adv,
+        }
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        dev = {
+            OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            ACTIONS: jnp.asarray(np.asarray(batch[ACTIONS])),
+            RETURNS: jnp.asarray(np.asarray(batch[RETURNS], np.float32)),
+        }
+        self.params, self.opt_state, self.ma_sqd_adv, stats = (
+            self._update_jit(self.params, self.opt_state,
+                             self.ma_sqd_adv, dev))
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["ma_sqd_adv"] = float(self.ma_sqd_adv)
+        return state
+
+    def set_state(self, state: dict) -> bool:
+        super().set_state(state)
+        if "ma_sqd_adv" in state:
+            self.ma_sqd_adv = jnp.asarray(state["ma_sqd_adv"])
+        return True
+
+
+class MARWIL(Algorithm):
+    """Offline driver: batches come from the DatasetReader, never from
+    env runners (reference: BC/MARWIL training_step reads the offline
+    dataset; num_env_steps_sampled stays 0)."""
+
+    learner_cls = MARWILLearner
+
+    def __init__(self, config: "MARWILConfig"):
+        if not getattr(config, "input_", None):
+            raise ValueError(
+                "offline algorithms need config.offline_data(input_=...)")
+        if getattr(config, "num_learners", 0):
+            # fail at construction, not deep inside a learner actor:
+            # MARWILLearner has no compute_grads/ma_sqd_adv replication
+            # for the DDP path yet
+            raise ValueError(
+                "MARWIL/BC support num_learners=0 (single local learner) "
+                "only")
+        super().__init__(config)
+        self._reader = DatasetReader(
+            config.input_, gamma=config.gamma, seed=config.seed)
+
+    def _build_module(self):
+        probe = make_env(self.config.env, **self.config.env_config)
+        return ActorCriticModule(
+            probe.observation_space, probe.action_space,
+            hiddens=self.config.hiddens)
+
+    def train(self) -> Dict:
+        import time
+
+        t0 = time.monotonic()
+        batch = self._reader.next_batch(self.config.train_batch_size)
+        learn = self.training_step(batch)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            # offline: training touches no env
+            "num_env_steps_sampled_lifetime": 0,
+            "num_offline_transitions": self._reader.num_transitions,
+            "dataset_mean_episode_return":
+                self._reader.mean_episode_return,
+            "time_this_iter_s": time.monotonic() - t0,
+            **{f"learner/{k}": v for k, v in learn.items()},
+        }
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta = 0 (reference: bc/bc.py —
+    BCConfig subclasses MARWILConfig forcing beta 0)."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0
+        self.vf_coeff = 0.0  # BC needs no value function
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class BC(MARWIL):
+    pass

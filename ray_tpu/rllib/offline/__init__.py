@@ -1,0 +1,141 @@
+"""Offline RL: episode logging, dataset reading, offline training data.
+
+Reference: rllib/offline/ — JsonWriter (json_writer.py), dataset readers
+(dataset_reader.py, feeding SampleBatches from logged files), and the
+offline algorithms that consume them (BC/MARWIL). Re-designed on the
+native Data library: episodes are rows of a Dataset, written/read as
+JSONL or parquet, so logging and ingestion ride the same lazy-plan
+streaming machinery as every other data pipeline here.
+
+An EPISODE row is a dict of parallel lists:
+    {"obs": [[f32...] x T], "actions": [int/float x T],
+     "rewards": [f32 x T], "dones": [bool x T]}
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+
+RETURNS = "returns"  # reward-to-go column added by the reader
+
+
+def write_episodes(episodes: List[dict], path: str,
+                   file_format: str = "json") -> str:
+    """Write episode rows through the Data library (one JSONL/parquet
+    file set under ``path``). Returns the directory written."""
+    from ... import data
+
+    ds = data.from_items(list(episodes))
+    if file_format == "parquet":
+        ds.write_parquet(path)
+    elif file_format == "json":
+        ds.write_json(path)
+    else:
+        raise ValueError(f"unknown format {file_format!r}")
+    return path
+
+
+def collect_episodes(env_name: str, module, params,
+                     num_episodes: int = 50, seed: int = 0,
+                     explore: bool = True,
+                     env_config: Optional[dict] = None) -> List[dict]:
+    """Roll a policy out and return episode rows (the logging half of
+    the reference's output API: rollouts → JsonWriter)."""
+    import jax
+
+    from ..env import make_env
+
+    env = make_env(env_name, **(env_config or {}))
+    key = jax.random.PRNGKey(seed)
+    episodes: List[dict] = []
+    for ep in range(num_episodes):
+        obs = env.reset(seed=seed + ep)
+        rows: Dict[str, list] = {
+            "obs": [], "actions": [], "rewards": [], "dones": []}
+        done = False
+        while not done:
+            if explore:
+                key, sub = jax.random.split(key)
+                action, _logp, _v = module.sample_action(
+                    params, np.asarray(obs, np.float32)[None], sub)
+            else:
+                action = module.best_action(
+                    params, np.asarray(obs, np.float32)[None])
+            a = np.asarray(action)[0]
+            nobs, reward, terminated, truncated, _ = env.step(
+                a.item() if a.shape == () else a)
+            done = bool(terminated or truncated)
+            rows["obs"].append(np.asarray(obs, np.float32).tolist())
+            rows["actions"].append(
+                a.item() if a.shape == () else a.tolist())
+            rows["rewards"].append(float(reward))
+            rows["dones"].append(done)
+            obs = nobs
+        episodes.append(rows)
+    return episodes
+
+
+class DatasetReader:
+    """Feeds SampleBatches from logged episode files (reference:
+    offline/dataset_reader.py DatasetReader.next()). Loads episodes via
+    the Data library, flattens them to transitions with an extra
+    reward-to-go column (what MARWIL's advantage estimation needs — MC
+    returns, no bootstrapping), and serves uniformly-sampled minibatches."""
+
+    def __init__(self, paths, gamma: float = 0.99, seed: int = 0):
+        from ... import data
+
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [str(paths)]
+        paths = [str(p) for p in paths]
+        # format probe only — file DISCOVERY (recursive dir walks) is
+        # the data readers' job, not duplicated here
+        parquet = any(
+            p.endswith(".parquet") or (
+                os.path.isdir(p) and glob.glob(
+                    os.path.join(p, "**", "*.parquet"), recursive=True)
+            )
+            for p in paths
+        )
+        if parquet:
+            rows = data.read_parquet(paths).take_all()
+        else:
+            rows = data.read_json(paths).take_all()
+        if not rows:
+            raise ValueError(f"no episodes in {files}")
+        cols: Dict[str, List] = {
+            OBS: [], ACTIONS: [], REWARDS: [], DONES: [], RETURNS: []}
+        n_eps = 0
+        ep_returns: List[float] = []
+        for row in rows:
+            r = np.asarray(row["rewards"], np.float32)
+            # reward-to-go under gamma (reference MARWIL uses MC returns)
+            rtg = np.zeros_like(r)
+            acc = 0.0
+            for t in range(len(r) - 1, -1, -1):
+                acc = r[t] + gamma * acc
+                rtg[t] = acc
+            cols[OBS].append(np.asarray(row["obs"], np.float32))
+            cols[ACTIONS].append(np.asarray(row["actions"]))
+            cols[REWARDS].append(r)
+            cols[DONES].append(np.asarray(row["dones"], bool))
+            cols[RETURNS].append(rtg)
+            n_eps += 1
+            ep_returns.append(float(r.sum()))
+        self._cols = {k: np.concatenate(v) for k, v in cols.items()}
+        self.num_episodes = n_eps
+        self.num_transitions = len(self._cols[REWARDS])
+        self.mean_episode_return = float(np.mean(ep_returns))
+        self._rng = np.random.default_rng(seed)
+
+    def next_batch(self, n: int) -> SampleBatch:
+        idx = self._rng.integers(0, self.num_transitions, size=n)
+        return SampleBatch({k: v[idx] for k, v in self._cols.items()})
+
+    def as_batch(self) -> SampleBatch:
+        return SampleBatch(dict(self._cols))

@@ -135,6 +135,32 @@ class _ResponseFuture:
         return self._ref
 
 
+_STREAM_WAIT_POOL = None
+_STREAM_WAIT_POOL_LOCK = threading.Lock()
+
+
+def _stream_wait_executor():
+    """Shared wide pool for async stream-item waits: each __anext__
+    holds a thread for the full inter-token wait, and the event loop's
+    default executor (min(32, cpus+4) threads — tiny on small hosts)
+    would cap how many concurrent streams make progress. Sized like the
+    proxy's SSE pool."""
+    global _STREAM_WAIT_POOL
+    if _STREAM_WAIT_POOL is None:
+        with _STREAM_WAIT_POOL_LOCK:
+            if _STREAM_WAIT_POOL is None:
+                import os
+                from concurrent.futures import ThreadPoolExecutor
+
+                _STREAM_WAIT_POOL = ThreadPoolExecutor(
+                    max_workers=int(
+                        os.environ.get("RAY_TPU_SERVE_MAX_STREAMS",
+                                       "256")),
+                    thread_name_prefix="stream-wait",
+                )
+    return _STREAM_WAIT_POOL
+
+
 class DeploymentResponseGenerator:
     """Iterator over a streaming handle call's items (reference:
     serve/handle.py:510 DeploymentResponseGenerator — returned by
@@ -189,7 +215,8 @@ class DeploymentResponseGenerator:
 
         loop = asyncio.get_running_loop()
         try:
-            return await loop.run_in_executor(None, self.__next__)
+            return await loop.run_in_executor(
+                _stream_wait_executor(), self.__next__)
         except StopIteration:
             # StopIteration can't cross an executor future boundary —
             # it arrives as RuntimeError; probe directly to be safe

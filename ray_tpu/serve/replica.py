@@ -87,13 +87,19 @@ class ReplicaActor:
                     yield item
             elif inspect.isgenerator(result):
                 # sync generator: step it off-loop so a slow producer
-                # doesn't block the replica's event loop between items
+                # doesn't block the replica's event loop between items.
+                # Copy the context so the multiplexed-model-id
+                # ContextVar set above is visible inside the generator
+                # body (the non-streaming path does the same).
+                import contextvars
+
                 loop = asyncio.get_running_loop()
                 sentinel = object()
+                ctx = contextvars.copy_context()
 
                 def _next():
                     try:
-                        return next(result)
+                        return ctx.run(next, result)
                     except StopIteration:
                         return sentinel
 

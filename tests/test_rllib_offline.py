@@ -1,0 +1,147 @@
+"""Offline RL: logging, dataset reading, BC + MARWIL (VERDICT r4 #3).
+
+Reference surface: rllib/offline/dataset_reader.py (file → SampleBatch),
+json_writer.py (episode logging), algorithms/bc/bc.py + marwil/marwil.py
+(offline training with learning-curve behavior). Trains ONLY from a
+logged file — the test asserts zero env interaction during training.
+"""
+import numpy as np
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.rllib import (
+    BC, BCConfig, MARWIL, MARWILConfig, PPO, PPOConfig,
+)
+from ray_tpu.rllib.offline import (
+    DatasetReader, collect_episodes, write_episodes,
+)
+
+
+@pytest.fixture(scope="module")
+def ray_start():
+    ray.init(resources={"CPU": 16, "memory": 10**9})
+    yield
+    ray.shutdown()
+
+
+@pytest.fixture(scope="module")
+def expert_dataset(ray_start, tmp_path_factory):
+    """Train a quick PPO behavior policy on CartPole, log 60 episodes
+    of its (stochastic) rollouts to JSONL, return (path, behavior
+    return)."""
+    cfg = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=3e-4, num_epochs=8, minibatch_size=128)
+    )
+    algo = PPO(cfg)
+    for _ in range(20):
+        res = algo.train()
+    behavior_eval = algo.evaluate(num_episodes=10)
+    module = algo._module
+    params = algo.learner_group.get_weights()
+    episodes = collect_episodes("CartPole-v1", module, params,
+                                num_episodes=60, seed=7)
+    path = str(tmp_path_factory.mktemp("offline") / "cartpole")
+    write_episodes(episodes, path, file_format="json")
+    algo.stop()
+    logged_mean = float(np.mean(
+        [sum(e["rewards"]) for e in episodes]))
+    return path, behavior_eval, logged_mean
+
+
+def test_reader_roundtrip(expert_dataset):
+    path, _behavior, logged_mean = expert_dataset
+    reader = DatasetReader(path, gamma=0.99)
+    assert reader.num_episodes == 60
+    assert reader.num_transitions > 500
+    assert abs(reader.mean_episode_return - logged_mean) < 1e-3
+    b = reader.next_batch(256)
+    assert b["obs"].shape == (256, 4)
+    assert b["returns"].shape == (256,)
+    # reward-to-go of a CartPole transition is positive and bounded by
+    # the geometric series limit
+    assert (b["returns"] > 0).all()
+    assert b["returns"].max() <= 1.0 / (1.0 - 0.99) + 1e-3
+
+
+def test_parquet_roundtrip(ray_start, tmp_path):
+    eps = [
+        {"obs": [[0.0, 1.0], [1.0, 0.0]], "actions": [0, 1],
+         "rewards": [1.0, 1.0], "dones": [False, True]},
+        {"obs": [[0.5, 0.5]], "actions": [1], "rewards": [2.0],
+         "dones": [True]},
+    ]
+    path = str(tmp_path / "eps")
+    write_episodes(eps, path, file_format="parquet")
+    reader = DatasetReader(path, gamma=1.0)
+    assert reader.num_episodes == 2
+    assert reader.num_transitions == 3
+    full = reader.as_batch()
+    assert full["returns"].tolist() == [2.0, 1.0, 2.0]
+
+
+def test_bc_learns_from_file(expert_dataset):
+    """BC trained purely from logged expert data must approach the
+    behavior policy's return — far above random (~22) — with ZERO env
+    steps sampled."""
+    path, behavior_eval, logged_mean = expert_dataset
+    cfg = (
+        BCConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=path)
+        .training(lr=1e-3, train_batch_size=512)
+    )
+    algo = BC(cfg)
+    first_loss = None
+    for _ in range(150):
+        res = algo.train()
+        if first_loss is None:
+            first_loss = res["learner/policy_loss"]
+    assert res["num_env_steps_sampled_lifetime"] == 0
+    # learning curve: NLL of the logged actions fell (it bottoms out at
+    # the stochastic behavior policy's own conditional entropy, so
+    # require a decrease, not a large one)
+    assert res["learner/policy_loss"] < first_loss * 0.95, (
+        first_loss, res["learner/policy_loss"])
+    ret = algo.evaluate(num_episodes=10)
+    floor = min(0.6 * logged_mean, logged_mean - 30.0)
+    assert ret > max(40.0, floor), (
+        f"BC return {ret} vs behavior {logged_mean} (eval "
+        f"{behavior_eval})")
+    algo.stop()
+
+
+def test_marwil_learns_from_file(expert_dataset):
+    """MARWIL (beta=1) weights high-advantage logged actions harder;
+    on decent data it must reach a solid return, also offline-only."""
+    path, _behavior, logged_mean = expert_dataset
+    cfg = (
+        MARWILConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=path)
+        .training(lr=1e-3, train_batch_size=512, beta=1.0)
+    )
+    algo = MARWIL(cfg)
+    for _ in range(150):
+        res = algo.train()
+    assert res["num_env_steps_sampled_lifetime"] == 0
+    assert np.isfinite(res["learner/vf_loss"])
+    # the value head actually fits reward-to-go
+    assert res["learner/vf_loss"] < 2000.0
+    ret = algo.evaluate(num_episodes=10)
+    floor = min(0.6 * logged_mean, logged_mean - 30.0)
+    assert ret > max(40.0, floor), (
+        f"MARWIL return {ret} vs behavior {logged_mean}")
+    # checkpoint roundtrip carries the moving-average normalizer
+    state = algo.learner_group._local.get_state()
+    assert "ma_sqd_adv" in state
+    algo.stop()
+
+
+def test_offline_requires_input():
+    cfg = BCConfig().environment("CartPole-v1")
+    with pytest.raises(ValueError, match="offline_data"):
+        BC(cfg)
