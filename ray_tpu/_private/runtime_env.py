@@ -34,7 +34,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 
 # Cross-process build lock liveness: the holder touches the lockfile
@@ -60,9 +60,75 @@ def _pip_packages(runtime_env: dict) -> List[str]:
     return list(pip)
 
 
+def _uv_spec(runtime_env: dict):
+    """Parse runtime_env["uv"] (reference: _private/runtime_env/uv.py —
+    list of packages, or {"packages": [...], "uv_pip_install_options":
+    [...]}). Packages may be names, local wheel paths, or source dirs;
+    zero-egress clusters pass wheel paths / --find-links dirs."""
+    uv = runtime_env.get("uv")
+    if not uv:
+        return [], []
+    if isinstance(uv, dict):
+        return (list(uv.get("packages") or []),
+                list(uv.get("uv_pip_install_options") or []))
+    if isinstance(uv, str):
+        with open(uv) as f:
+            return ([ln.strip() for ln in f
+                     if ln.strip() and not ln.startswith("#")], [])
+    return list(uv), []
+
+
+def _conda_pip_packages(runtime_env: dict) -> List[str]:
+    """Conda SHIM (reference: _private/runtime_env/conda.py builds a
+    real conda env): without a conda binary in the image, the common
+    pure-Python case is honored by translating the environment spec's
+    dependencies to pip requirements — "pkg=1.2" → "pkg==1.2", nested
+    {"pip": [...]} passed through. Binary/conda-only deps will fail at
+    install time with the pip error in the env log."""
+    conda = runtime_env.get("conda")
+    if not conda:
+        return []
+    import re
+
+    if isinstance(conda, str):
+        with open(conda) as f:
+            lines = f.read().splitlines()
+        # minimal env.yml parse (no yaml dep): every "- item" inside the
+        # dependencies block — top-level conda deps AND the pip sublist
+        # both end up pip-installed by this shim anyway
+        deps: List[str] = []
+        in_deps = False
+        for ln in lines:
+            s = ln.strip()
+            if s.startswith("dependencies:"):
+                in_deps = True
+            elif re.match(r"^[A-Za-z_]\w*:", s):
+                in_deps = False
+            elif in_deps and s.startswith("- ") and not s.endswith(":"):
+                deps.append(s[2:].strip())
+        conda = {"dependencies": deps}
+    out: List[str] = []
+    for dep in conda.get("dependencies", []):
+        if isinstance(dep, dict):
+            out.extend(dep.get("pip") or [])
+        elif isinstance(dep, str):
+            if re.split(r"[=<>]", dep)[0] in ("python", "pip"):
+                continue  # interpreter/installer pins: the venv decides
+            # conda 3-part spec "pkg=ver=build" (conda env export):
+            # the build string is conda-only — drop it
+            m = re.match(r"^([A-Za-z0-9_.\-]+)=([^=]+)=[^=]+$", dep)
+            if m:
+                dep = f"{m.group(1)}={m.group(2)}"
+            # conda "pkg=1.2" pin -> pip "pkg==1.2"; >=/<=/== pass through
+            out.append(re.sub(r"^([A-Za-z0-9_.\-]+)=(?=[^=])",
+                              r"\1==", dep))
+    return out
+
+
 def needs_materialization(runtime_env: Optional[dict]) -> bool:
     return bool(runtime_env) and bool(
         runtime_env.get("pip") or runtime_env.get("py_modules")
+        or runtime_env.get("uv") or runtime_env.get("conda")
     )
 
 
@@ -89,8 +155,11 @@ class RuntimeEnvManager:
 
     @staticmethod
     def env_hash(runtime_env: dict) -> str:
+        uv_pkgs, uv_args = _uv_spec(runtime_env)
         payload = {
             "pip": _pip_packages(runtime_env),
+            "uv": [uv_pkgs, uv_args],
+            "conda": _conda_pip_packages(runtime_env),
             "py_modules": list(runtime_env.get("py_modules") or []),
         }
         return hashlib.sha1(
@@ -227,9 +296,14 @@ class RuntimeEnvManager:
         hb.start()
         try:
             python, pythonpath = None, []
-            pkgs = _pip_packages(runtime_env)
+            uv_pkgs, uv_args = _uv_spec(runtime_env)
+            pkgs = (uv_pkgs + _pip_packages(runtime_env)
+                    + _conda_pip_packages(runtime_env))
             if pkgs:
-                python = self._build_venv(envdir, pkgs, log)
+                python = self._build_venv(
+                    envdir, pkgs, log,
+                    installer="uv" if uv_pkgs else "pip",
+                    extra_args=uv_args)
             mods = list(runtime_env.get("py_modules") or [])
             if mods:
                 pythonpath.append(
@@ -269,7 +343,9 @@ class RuntimeEnvManager:
                 f"command failed (exit {res.returncode}): {' '.join(cmd)}"
             )
 
-    def _build_venv(self, envdir: str, pkgs: List[str], log) -> str:
+    def _build_venv(self, envdir: str, pkgs: List[str], log,
+                    installer: str = "pip",
+                    extra_args: Sequence[str] = ()) -> str:
         vdir = os.path.join(envdir, "venv")
         self._run(
             [sys.executable, "-m", "venv", "--system-site-packages", vdir],
@@ -304,6 +380,25 @@ class RuntimeEnvManager:
             f.write("\n".join(parent_sites) + "\n")
         # --no-build-isolation would need network for build deps; local
         # wheels and cached indexes both work through plain install.
+        if installer == "uv":
+            uv = shutil.which("uv")
+            if uv is not None:
+                # reference: runtime_env/uv.py — uv's resolver/installer
+                # against the SAME venv; wheel paths and --find-links
+                # dirs work fully offline
+                self._run([uv, "pip", "install", "--python", py,
+                           *extra_args, *pkgs], log)
+                return py
+            # uv-specific options (--offline, ...) are NOT pip options:
+            # the fallback drops them rather than feeding pip flags it
+            # rejects — noted in the env log
+            if extra_args:
+                log.write(
+                    b"uv binary not found; falling back to pip and "
+                    b"DROPPING uv_pip_install_options "
+                    + " ".join(extra_args).encode() + b"\n")
+            else:
+                log.write(b"uv binary not found; falling back to pip\n")
         self._run([py, "-m", "pip", "install", "--no-input", *pkgs], log)
         return py
 

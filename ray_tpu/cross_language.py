@@ -166,14 +166,22 @@ def _get_cpp_proxy_cls():
         @ray_tpu.remote
         class _CppActorProxy:
             def __init__(self, cls_name: str, init_payload: bytes,
-                         timeout_s: float = 60.0):
+                         timeout_s: float = 60.0, host: str = "",
+                         port: int = 0):
                 import uuid
 
                 from ray_tpu._private.core_worker import global_worker
                 from ray_tpu.cross_language import _resolve_cpp_worker
 
-                self._host, self._port, _ = _resolve_cpp_worker(
-                    "actor:" + cls_name)
+                if host and port:
+                    # the creator already resolved the worker (and
+                    # pinned this proxy to its node): reuse that
+                    # resolution — a second lookup could race to a
+                    # DIFFERENT worker serving the same class
+                    self._host, self._port = host, int(port)
+                else:
+                    self._host, self._port, _ = _resolve_cpp_worker(
+                        "actor:" + cls_name)
                 self._aid = uuid.uuid4().hex
                 self._timeout = float(timeout_s)
                 w = global_worker()
@@ -237,7 +245,8 @@ def cpp_actor_class(cls_name: str):
             """``timeout_s``: default RPC timeout for create/call/destroy
             (long-running native methods should raise it; per-call
             override via ``handle.call(..., timeout_s=...)``)."""
-            _h, _p, node_id = _resolve_cpp_worker("actor:" + cls_name)
+            host, port, node_id = _resolve_cpp_worker(
+                "actor:" + cls_name)
             proxy_cls = _get_cpp_proxy_cls()
             opts = {"max_concurrency": 1}
             if node_id:
@@ -248,7 +257,7 @@ def cpp_actor_class(cls_name: str):
                 opts["scheduling_strategy"] = (
                     NodeAffinitySchedulingStrategy(node_id))
             proxy = proxy_cls.options(**opts).remote(
-                cls_name, bytes(init_payload), timeout_s)
+                cls_name, bytes(init_payload), timeout_s, host, port)
             return CppActorHandle(proxy)
 
         def __repr__(self):

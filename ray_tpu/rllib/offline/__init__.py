@@ -107,7 +107,7 @@ class DatasetReader:
         else:
             rows = data.read_json(paths).take_all()
         if not rows:
-            raise ValueError(f"no episodes in {files}")
+            raise ValueError(f"no episodes in {paths}")
         cols: Dict[str, List] = {
             OBS: [], ACTIONS: [], REWARDS: [], DONES: [], RETURNS: []}
         n_eps = 0

@@ -108,3 +108,79 @@ def test_pip_failure_surfaces(ray_start):
 
     with pytest.raises(ray.RayError, match="runtime_env"):
         ray.get(should_fail.remote(), timeout=120)
+
+
+def test_uv_wheel_task(ray_start, local_wheel):
+    """runtime_env={"uv": [...]} (VERDICT r4 #5, reference:
+    _private/runtime_env/uv.py): materialize the env with uv's
+    installer from a vendored local wheel — fully offline — and run
+    the task inside it."""
+
+    @ray.remote(runtime_env={
+        "uv": {"packages": [local_wheel],
+               "uv_pip_install_options": ["--offline"]},
+    })
+    def use_wheel():
+        import graft_re_mod
+
+        return graft_re_mod.VALUE, sys.prefix
+
+    value, prefix = ray.get(use_wheel.remote(), timeout=180)
+    assert value == 42
+    assert "runtime_envs" in prefix
+
+    # uv env key differs from the pip env key for the same wheel (the
+    # installer is part of the env identity)
+    from ray_tpu._private.runtime_env import RuntimeEnvManager
+
+    assert RuntimeEnvManager.env_hash(
+        {"uv": [local_wheel]}
+    ) != RuntimeEnvManager.env_hash({"pip": [local_wheel]})
+
+
+def test_conda_shim_task(ray_start, local_wheel):
+    """Conda SHIM (reference: runtime_env/conda.py): the env spec's
+    pip sublist materializes through the venv machinery; conda-pinned
+    "pkg=ver" entries translate to pip pins."""
+    from ray_tpu._private.runtime_env import _conda_pip_packages
+
+    assert _conda_pip_packages(
+        {"conda": {"dependencies": [
+            "python=3.12", "numpy=1.26", "scipy>=1.0",
+            {"pip": ["requests==2.31"]},
+        ]}}
+    ) == ["numpy==1.26", "scipy>=1.0", "requests==2.31"]
+
+    @ray.remote(runtime_env={
+        "conda": {"dependencies": [{"pip": [local_wheel]}]},
+    })
+    def use_wheel():
+        import graft_re_mod
+
+        return graft_re_mod.VALUE, sys.prefix
+
+    value, prefix = ray.get(use_wheel.remote(), timeout=180)
+    assert value == 42
+    assert "runtime_envs" in prefix
+
+
+def test_conda_yaml_parse(tmp_path):
+    """environment.yml form: dependencies block parsed without a yaml
+    dependency; name/channels blocks ignored."""
+    from ray_tpu._private.runtime_env import _conda_pip_packages
+
+    yml = tmp_path / "environment.yml"
+    yml.write_text(
+        "name: test-env\n"
+        "channels:\n"
+        "  - defaults\n"
+        "dependencies:\n"
+        "  - python=3.12\n"
+        "  - numpy=1.26\n"
+        "  - pip\n"
+        "  - pip:\n"
+        "    - requests==2.31\n"
+        "name2: trailing\n"
+    )
+    assert _conda_pip_packages({"conda": str(yml)}) == [
+        "numpy==1.26", "requests==2.31"]
