@@ -119,9 +119,13 @@ def _conda_pip_packages(runtime_env: dict) -> List[str]:
             m = re.match(r"^([A-Za-z0-9_.\-]+)=([^=]+)=[^=]+$", dep)
             if m:
                 dep = f"{m.group(1)}={m.group(2)}"
-            # conda "pkg=1.2" pin -> pip "pkg==1.2"; >=/<=/== pass through
-            out.append(re.sub(r"^([A-Za-z0-9_.\-]+)=(?=[^=])",
-                              r"\1==", dep))
+            # conda's single "=" is a PREFIX match ("numpy=1.26"
+            # matches 1.26.4) -> pip "numpy==1.26.*"; >=/<=/== pass
+            # through untouched
+            m = re.match(r"^([A-Za-z0-9_.\-]+)=([^=<>].*)$", dep)
+            if m and not m.group(2).endswith("*"):
+                dep = f"{m.group(1)}=={m.group(2)}.*"
+            out.append(dep)
     return out
 
 

@@ -8,11 +8,15 @@ from ..train.checkpoint import Checkpoint  # noqa: F401
 from .schedulers import (  # noqa: F401
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from .search import (  # noqa: F401
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,

@@ -200,3 +200,164 @@ class PopulationBasedTraining(TrialScheduler):
                 ]
                 return (EXPLOIT, source)
         return CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Classic (bracketed) HyperBand (reference:
+    tune/schedulers/hyperband.py HyperBandScheduler): trials are dealt
+    round-robin into s_max+1 brackets; bracket s starts its trials with
+    budget max_t * eta^-s and successively halves at each rung, keeping
+    the top 1/eta. Unlike ASHA (one bracket, async), the bracket
+    structure hedges between "many short trials" and "few long trials"."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 max_t: int = 81, reduction_factor: float = 3,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.time_attr = time_attr
+        self.s_max = int(np.floor(np.log(max_t) / np.log(self.eta)))
+        # bracket s: rungs at max_t * eta^(i - s) for i in 0..s
+        self._brackets: List[List[_Rung]] = []
+        for s in range(self.s_max + 1):
+            rungs = [
+                _Rung(int(np.ceil(max_t * self.eta ** (i - s))))
+                for i in range(s)
+            ]
+            rungs.sort(key=lambda r: -r.milestone)
+            self._brackets.append(rungs)
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def on_trial_add(self, trial):
+        # deal round-robin over brackets (reference fills brackets by
+        # capacity; round-robin keeps every bracket live at small n)
+        self._assignment[trial.trial_id] = self._next_bracket
+        self._next_bracket = (self._next_bracket + 1) % len(
+            self._brackets)
+
+    def on_result(self, trial, result, trials):
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        t = result.get(self.time_attr, trial.iteration)
+        if t >= self.max_t:
+            return COMPLETE
+        score = value if self.mode == "max" else -value
+        rungs = self._brackets[self._assignment.get(trial.trial_id, 0)]
+        for rung in rungs:
+            if t < rung.milestone or trial.trial_id in rung.recorded:
+                continue
+            cutoff = rung.cutoff(self.eta)
+            rung.recorded[trial.trial_id] = score
+            if cutoff is not None and score < cutoff:
+                return STOP
+            break
+        return CONTINUE
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with a model-guided explore step (reference:
+    tune/schedulers/pb2.py — GP-bandit selection of the next
+    hyperparameters instead of random perturbation). The exploit
+    decision is inherited; explore() fits a tiny RBF-kernel GP on
+    (hyperparam vector → recent reward delta) across the population and
+    picks the UCB-best of K candidate perturbations — no sklearn/GPy
+    dependency."""
+
+    def __init__(self, *args, ucb_kappa: float = 1.0,
+                 n_candidates: int = 16, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.ucb_kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        # (config-vector, delta) observations, bounded
+        self._deltas: List[Tuple[np.ndarray, float]] = []
+        self._last_score: Dict[str, float] = {}
+
+    # -- observation capture ------------------------------------------
+    def on_result(self, trial, result, trials):
+        v = result.get(self.metric)
+        if v is not None:
+            s = v if self.mode == "max" else -v
+            prev = self._last_score.get(trial.trial_id)
+            if prev is not None:
+                vec = self._vectorize(trial.config)
+                if vec is not None:
+                    self._deltas.append((vec, s - prev))
+                    if len(self._deltas) > 256:
+                        self._deltas.pop(0)
+            self._last_score[trial.trial_id] = s
+        decision = super().on_result(trial, result, trials)
+        if isinstance(decision, tuple) and decision[0] == EXPLOIT:
+            # the trial restarts from ANOTHER trial's checkpoint: its
+            # next score jump is the clone, not the new hyperparams —
+            # never feed that delta to the GP
+            self._last_score.pop(trial.trial_id, None)
+        return decision
+
+    # -- model-guided explore (called by the tuner on EXPLOIT) --------
+    def explore(self, source_config, param_space, rng):
+        from . import search as search_mod
+
+        candidates = [
+            search_mod.perturb_config(source_config, param_space, rng)
+            for _ in range(self.n_candidates)
+        ]
+        # vectors can be ragged (mixed-type choices vectorize to
+        # different lengths): model only the modal length, and fall
+        # back to the first candidate on any numerical failure — a
+        # surrogate hiccup must never kill the experiment
+        try:
+            return self._explore_gp(candidates)
+        except Exception:  # noqa: BLE001 — surrogate must never kill fit()
+            return candidates[0]
+
+    def _explore_gp(self, candidates):
+        cand_vecs = [self._vectorize(c) for c in candidates]
+        dim = next((len(v) for v in cand_vecs if v is not None), 0)
+        obs = [(v, d) for v, d in self._deltas if len(v) == dim]
+        if dim == 0 or len(obs) < 4:
+            return candidates[0]
+        X = np.stack([v for v, _d in obs])
+        y = np.asarray([d for _v, d in obs])
+        y = (y - y.mean()) / (y.std() + 1e-8)
+        keep = [i for i, v in enumerate(cand_vecs)
+                if v is not None and len(v) == dim]
+        if not keep:
+            return candidates[0]
+        Xc = np.stack([cand_vecs[i] for i in keep])
+        candidates = [candidates[i] for i in keep]
+        # normalize per dimension for a unit-lengthscale RBF kernel
+        mu, sd = X.mean(0), X.std(0) + 1e-8
+        Xn, Xcn = (X - mu) / sd, (Xc - mu) / sd
+
+        def rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2)
+
+        K = rbf(Xn, Xn) + 1e-3 * np.eye(len(Xn))
+        Ks = rbf(Xcn, Xn)
+        Kinv = np.linalg.inv(K)
+        mean = Ks @ Kinv @ y
+        var = np.clip(1.0 - np.einsum("ij,jk,ik->i", Ks, Kinv, Ks),
+                      1e-9, None)
+        ucb = mean + self.ucb_kappa * np.sqrt(var)
+        return candidates[int(np.argmax(ucb))]
+
+    def _vectorize(self, config) -> Optional[np.ndarray]:
+        vals = []
+        for v in _flatten(config):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            vals.append(float(v))
+        return np.asarray(vals) if vals else None
+
+
+def _flatten(cfg):
+    for v in cfg.values():
+        if isinstance(v, dict):
+            yield from _flatten(v)
+        else:
+            yield v

@@ -9,7 +9,7 @@ external searcher deps (optuna/hyperopt are cloud-side concerns).
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -174,3 +174,208 @@ def perturb_config(
             if ok and path[-1] in node:
                 node[path[-1]] = spec.perturb(node[path[-1]], rng)
     return new
+
+
+# ---------------------------------------------------------------------------
+# Model-based search (reference: tune/search/searcher.py Searcher API;
+# tune/search/optuna/optuna_search.py wraps optuna's TPE sampler — here
+# the TPE is native, zero-dependency, over the same Domain param space)
+# ---------------------------------------------------------------------------
+
+
+class Searcher:
+    """Sequential config suggester: the tuner asks for one config per
+    new trial and reports completions back, so later suggestions are
+    informed by earlier results."""
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              space: Dict[str, Any]) -> None:
+        self.metric = metric
+        self.mode = mode
+        self.space = space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+    def on_trial_config_update(self, trial_id: str,
+                               config: Dict[str, Any]) -> None:
+        """A scheduler replaced the trial's config (PBT exploit): the
+        model must credit the eventual result to what actually ran."""
+        pass
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (the model behind optuna's
+    default sampler and hyperopt): observations split into a GOOD top
+    quantile and the rest; each numeric dimension gets a Parzen
+    (Gaussian-kernel) density for both sets, categoricals get smoothed
+    count weights. Candidates are drawn from the good model and ranked
+    by the density ratio l(x)/g(x) — the next trial lands where good
+    configs are dense and bad ones are not.
+
+    Independent per-dimension models, like hyperopt's default; log
+    domains modeled in log space."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None,
+                 n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 64, explore_eps: float = 0.2,
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        # ε-mixing: this fraction of suggestions are pure prior draws —
+        # the density-ratio argmax alone can lock onto an early local
+        # cluster and never probe the rest of the domain
+        self.explore_eps = explore_eps
+        self.rng = np.random.default_rng(seed)
+        self.space: Dict[str, Any] = {}
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[Tuple[Dict[str, Any], float]] = []
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(metric, mode, space)
+        for path, spec in _split_space(space):
+            if _is_grid(spec):
+                raise ValueError(
+                    "TPESearcher does not combine with grid_search; "
+                    "use choice() instead")
+
+    # -- observation bookkeeping --------------------------------------
+    def on_trial_complete(self, trial_id, result):
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = (float(value) if (self.mode or "max") == "max"
+                 else -float(value))
+        self._obs.append((cfg, score))
+
+    def on_trial_config_update(self, trial_id, config):
+        if trial_id in self._pending:
+            self._pending[trial_id] = config
+
+    # -- suggestion ---------------------------------------------------
+    def suggest(self, trial_id):
+        if (len(self._obs) < self.n_initial
+                or self.rng.random() < self.explore_eps):
+            cfg = next(generate_variants(
+                self.space, 1,
+                seed=int(self.rng.integers(2**31 - 1))))
+        else:
+            cfg = self._tpe_config()
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def _tpe_config(self) -> Dict[str, Any]:
+        ranked = sorted(self._obs, key=lambda p: p[1], reverse=True)
+        n_good = max(1, int(np.ceil(self.gamma * len(ranked))))
+        good = [c for c, _s in ranked[:n_good]]
+        bad = [c for c, _s in ranked[n_good:]] or good
+        cfg: Dict[str, Any] = {}
+        for path, spec in _split_space(self.space):
+            if isinstance(spec, Categorical):
+                _set_path(cfg, path, self._tpe_categorical(
+                    path, spec, good, bad))
+            elif isinstance(spec, (Float, Integer)):
+                _set_path(cfg, path, self._tpe_numeric(
+                    path, spec, good, bad))
+            elif isinstance(spec, Domain):
+                _set_path(cfg, path, spec.sample(self.rng))
+            else:
+                _set_path(cfg, path, spec)
+        return cfg
+
+    @staticmethod
+    def _get_path(cfg, path):
+        node = cfg
+        for k in path:
+            node = node[k]
+        return node
+
+    def _tpe_categorical(self, path, spec, good, bad):
+        cats = list(spec.categories)
+        prior = 1.0  # Laplace smoothing
+
+        def weights(obs):
+            w = np.full(len(cats), prior)
+            for c in obs:
+                try:
+                    w[cats.index(self._get_path(c, path))] += 1.0
+                except (ValueError, KeyError):
+                    pass
+            return w / w.sum()
+
+        ratio = weights(good) / weights(bad)
+        # sample ∝ good-weight, tilted by the ratio (argmax over the
+        # tilted distribution == pick the best-looking category while
+        # keeping exploration mass on near-ties)
+        p = weights(good) * ratio
+        p = p / p.sum()
+        return cats[int(self.rng.choice(len(cats), p=p))]
+
+    def _tpe_numeric(self, path, spec, good, bad):
+        log = isinstance(spec, Float) and spec.log
+        lo, hi = float(spec.lower), float(spec.upper)
+        tlo, thi = (np.log(lo), np.log(hi)) if log else (lo, hi)
+
+        def xs(obs):
+            vals = []
+            for c in obs:
+                try:
+                    v = float(self._get_path(c, path))
+                except (KeyError, TypeError):
+                    continue
+                vals.append(np.log(v) if log else v)
+            return np.asarray(vals) if vals else np.asarray([
+                (tlo + thi) / 2.0])
+
+        gx, bx = xs(good), xs(bad)
+        width = thi - tlo
+        # Scott-style bandwidth with a floor so early models stay wide
+        def bw(x):
+            s = float(np.std(x)) if len(x) > 1 else width / 4.0
+            return max(s * len(x) ** (-1 / 5), width / 20.0)
+
+        gbw, bbw = bw(gx), bw(bx)
+        # hyperopt-style uniform PRIOR kernel mixed into BOTH densities
+        # (a wide Gaussian at the domain midpoint): keeps tail mass in
+        # l(x) so the search can jump out of an early cluster, and
+        # floors g(x) so the ratio can't diverge at the edges
+        mid = (tlo + thi) / 2.0
+        gcent = np.append(gx, mid)
+        ghs = np.append(np.full(len(gx), gbw), width)
+        bcent = np.append(bx, mid)
+        bhs = np.append(np.full(len(bx), bbw), width)
+
+        def logpdf(x, centers, hs):
+            d = (x[:, None] - centers[None, :]) / hs[None, :]
+            k = -0.5 * d * d - np.log(hs[None, :] * np.sqrt(2 * np.pi))
+            m = k.max(axis=1, keepdims=True)
+            return (m[:, 0] + np.log(
+                np.exp(k - m).sum(axis=1) / len(centers)))
+
+        # candidates: mostly from the good mixture, a quarter from the
+        # prior (uniform over the domain) for exploration
+        n_prior = max(1, self.n_candidates // 4)
+        n_good = self.n_candidates - n_prior
+        idx = self.rng.integers(0, len(gx), size=n_good)
+        cand = np.concatenate([
+            gx[idx] + self.rng.normal(0.0, gbw, n_good),
+            self.rng.uniform(tlo, thi, n_prior),
+        ])
+        cand = np.clip(cand, tlo, thi)
+        score = logpdf(cand, gcent, ghs) - logpdf(cand, bcent, bhs)
+        best = float(cand[int(np.argmax(score))])
+        value = float(np.exp(best)) if log else best
+        if isinstance(spec, Integer):
+            return int(np.clip(round(value), spec.lower, spec.upper - 1))
+        return float(np.clip(value, lo, hi))

@@ -149,6 +149,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    # model-based sequential searcher (search.Searcher, e.g.
+    # TPESearcher); None = BasicVariant up-front generation
+    search_alg: Optional[Any] = None
     seed: Optional[int] = None
     max_failures_per_trial: int = 0
     trial_resources: Dict[str, float] = field(
@@ -306,8 +309,22 @@ class Tuner:
                             tc.trial_resources)
 
         # --- build / restore trial set -------------------------------
+        searcher = tc.search_alg
+        searcher_exhausted = False
+        if searcher is not None:
+            # constructor-set metric/mode win; TuneConfig fills the gaps
+            # (Searcher defaults both to None so tc.mode CAN apply)
+            searcher.set_search_properties(
+                getattr(searcher, "metric", None) or tc.metric,
+                getattr(searcher, "mode", None) or tc.mode,
+                self.param_space)
         if self._restored_trials is not None:
             trials = self._restored_trials
+        elif searcher is not None:
+            # model-based search is SEQUENTIAL: trials are created
+            # lazily (see _maybe_suggest below) so each suggestion is
+            # informed by completions (reference: SearchGenerator)
+            trials = []
         else:
             trials = [
                 Trial(trial_id=f"t{i:05d}_{uuid.uuid4().hex[:6]}",
@@ -319,8 +336,35 @@ class Tuner:
         for t in trials:
             scheduler.on_trial_add(t)
 
-        max_concurrent = tc.max_concurrent_trials or max(
-            1, len(trials))
+        max_concurrent = tc.max_concurrent_trials or (
+            4 if searcher is not None else max(1, len(trials)))
+        issued = len(trials)
+
+        def _maybe_suggest():
+            nonlocal issued, searcher_exhausted
+            if searcher is None or searcher_exhausted:
+                return
+            active = sum(t.status in (RUNNING, PENDING) for t in trials)
+            while issued < tc.num_samples and active < max_concurrent:
+                tid = f"t{issued:05d}_{uuid.uuid4().hex[:6]}"
+                cfg = searcher.suggest(tid)
+                if cfg is None:
+                    # exhausted: stop asking AND stop waiting for the
+                    # never-to-arrive remaining samples (hang otherwise)
+                    searcher_exhausted = True
+                    return
+                t = Trial(trial_id=tid, config=cfg)
+                scheduler.on_trial_add(t)
+                trials.append(t)
+                issued += 1
+                active += 1
+
+        def _notify_searcher(t: Trial):
+            if searcher is not None:
+                try:
+                    searcher.on_trial_complete(t.trial_id, t.last_result)
+                except Exception:  # noqa: BLE001 — searcher bugs must
+                    pass           # not kill the experiment loop
         actors: Dict[str, Any] = {}
         import numpy as np
 
@@ -399,11 +443,16 @@ class Tuner:
                 t.status = ERROR
                 t.error = err
                 scheduler.on_trial_complete(t)
+                _notify_searcher(t)
 
         # --- event loop ----------------------------------------------
         persist()
         try:
-            while any(not t.is_finished() for t in trials):
+            while any(not t.is_finished() for t in trials) or (
+                searcher is not None and not searcher_exhausted
+                and issued < tc.num_samples
+            ):
+                _maybe_suggest()
                 # launch pending trials up to the concurrency cap
                 running = [t for t in trials if t.status == RUNNING]
                 for t in trials:
@@ -443,8 +492,18 @@ class Tuner:
                         if source.checkpoint_path:
                             t.checkpoint_path = save_trial_checkpoint(
                                 t, source.checkpoint_path)
-                        t.config = search_mod.perturb_config(
-                            source.config, self.param_space, rng)
+                        explore = getattr(scheduler, "explore", None)
+                        t.config = (
+                            explore(source.config, self.param_space, rng)
+                            if explore is not None
+                            else search_mod.perturb_config(
+                                source.config, self.param_space, rng))
+                        if searcher is not None:
+                            # the trial now runs a DIFFERENT config: a
+                            # model-based searcher must not credit the
+                            # eventual score to its stale suggestion
+                            searcher.on_trial_config_update(
+                                t.trial_id, t.config)
                         t.status = PENDING  # restart exploited trial
                         dirty = True
                         continue
@@ -453,6 +512,7 @@ class Tuner:
                         t.status = TERMINATED
                         t.stopped_early = decision == STOP
                         scheduler.on_trial_complete(t)
+                        _notify_searcher(t)
                         dirty = True
                         continue
                     if p["error"]:
@@ -462,6 +522,7 @@ class Tuner:
                         stop_actor(t)
                         t.status = TERMINATED
                         scheduler.on_trial_complete(t)
+                        _notify_searcher(t)
                         dirty = True
                 if dirty:
                     persist()

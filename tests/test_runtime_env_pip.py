@@ -147,9 +147,11 @@ def test_conda_shim_task(ray_start, local_wheel):
     assert _conda_pip_packages(
         {"conda": {"dependencies": [
             "python=3.12", "numpy=1.26", "scipy>=1.0",
+            "lz4=4.3.2=py312_0",
             {"pip": ["requests==2.31"]},
         ]}}
-    ) == ["numpy==1.26", "scipy>=1.0", "requests==2.31"]
+    ) == ["numpy==1.26.*", "scipy>=1.0", "lz4==4.3.2.*",
+          "requests==2.31"]
 
     @ray.remote(runtime_env={
         "conda": {"dependencies": [{"pip": [local_wheel]}]},
@@ -183,4 +185,4 @@ def test_conda_yaml_parse(tmp_path):
         "name2: trailing\n"
     )
     assert _conda_pip_packages({"conda": str(yml)}) == [
-        "numpy==1.26", "requests==2.31"]
+        "numpy==1.26.*", "requests==2.31"]
