@@ -48,6 +48,11 @@ class LlamaConfig:
     # (≈⅓ less recompute FLOPs when activations fit); "none" via
     # remat=False
     remat_policy: str = "full"
+    # chunked cross-entropy: sequence-chunk size for the loss (0 = one
+    # full [B, S, vocab] logits tensor). Chunking keeps only chunk-wide
+    # f32 logits live (recomputed in bwd), trading one extra vocab
+    # matmul for ~1 GiB peak HBM at the flagship size.
+    ce_chunk: int = 0
     # MoE (0 = dense). Mixtral-style top-k routing; experts shard over
     # the "expert" mesh axis (models/moe.py).
     n_experts: int = 0
@@ -270,9 +275,12 @@ def forward(
     tokens: jax.Array,  # [B, S] int32
     mesh=None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ):
     """Returns logits [B, S, vocab] (f32); with return_aux, also the
-    summed MoE load-balance aux loss."""
+    summed MoE load-balance aux loss. return_hidden skips the vocab
+    projection and returns (final-norm hidden states [B, S, d], aux) —
+    the chunked-CE loss path projects per chunk instead."""
     B, S = tokens.shape
     x = params["tok_embed"][tokens]  # [B, S, d]
     positions = jnp.arange(S)
@@ -315,6 +323,8 @@ def forward(
             body, (x, jnp.zeros((), jnp.float32)), params["layers"]
         )
     x = _rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if return_hidden:
+        return x, aux
     head = (
         params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"]
     )
@@ -566,12 +576,61 @@ def loss_fn(
     mesh=None,
 ) -> jax.Array:
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = forward(cfg, params, inputs, mesh=mesh, return_aux=True)
-    # logsumexp form: no [B, S, vocab] log-softmax tensor materialized
-    # (the reduction fuses with the logits matmul's epilogue)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(lse - tgt)
+    B, S = inputs.shape
+    chunk = int(getattr(cfg, "ce_chunk", 0) or 0)
+    if chunk > 0 and S % chunk:
+        # a silent dense fallback would quietly forfeit the ~1 GiB
+        # peak-HBM saving the flag promises (and OOM configs sized for
+        # it) — surface the misconfiguration instead
+        raise ValueError(
+            f"ce_chunk={chunk} must divide the training sequence "
+            f"length S={S} (tokens are [B, S+1])")
+    if chunk <= 0 or S == chunk:
+        logits, aux = forward(cfg, params, inputs, mesh=mesh,
+                              return_aux=True)
+        # logsumexp form: no [B, S, vocab] log-softmax tensor
+        # materialized (the reduction fuses with the logits matmul's
+        # epilogue)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1)[..., 0]
+        loss = jnp.mean(lse - tgt)
+    else:
+        # CHUNKED, REMATERIALIZED cross-entropy: the [B, S, vocab] f32
+        # logits tensor (~1 GiB at the flagship size) never fully
+        # exists — per-chunk logits are computed, reduced to lse/target
+        # scores, and recomputed in the backward pass (jax.checkpoint),
+        # cutting both peak HBM and logits write-back traffic. This is
+        # what frees enough memory to raise the flagship batch size.
+        x, aux = forward(cfg, params, inputs, mesh=mesh,
+                         return_hidden=True)
+        head = (
+            params["tok_embed"].T if cfg.tie_embeddings
+            else params["lm_head"]
+        ).astype(cfg.dtype)
+        nC = S // chunk
+        xs = x.reshape(B, nC, chunk, -1).transpose(1, 0, 2, 3)
+        ts = targets.reshape(B, nC, chunk).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def chunk_nll(x_c, t_c):
+            logits = jax.lax.dot_general(
+                x_c.astype(cfg.dtype), head,
+                (((x_c.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(
+                logits, t_c[..., None], axis=-1)[..., 0]
+            return jnp.sum(lse - tgt)
+
+        def body(acc, xt):
+            x_c, t_c = xt
+            return acc + chunk_nll(x_c, t_c), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (xs, ts))
+        loss = total / (B * S)
     if cfg.n_experts > 0:
         loss = loss + cfg.router_aux_coef * aux / cfg.n_layers
     return loss

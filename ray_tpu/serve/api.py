@@ -101,20 +101,32 @@ def _get_or_create_proxy(http_host: str, http_port: int):
         return handle
 
 
-def _get_or_create_grpc_proxy(host: str, port: int):
+def _get_or_create_grpc_proxy(host: str, port: int,
+                              servicer_functions: tuple = ()):
     import ray_tpu as ray
 
     from .grpc_proxy import GrpcProxyActor
 
     try:
-        return ray.get_actor(_GRPC_PROXY_NAME)
+        existing = ray.get_actor(_GRPC_PROXY_NAME)
     except ValueError:
-        Proxy = ray.remote(GrpcProxyActor)
-        handle = Proxy.options(
-            name=_GRPC_PROXY_NAME, lifetime="detached", max_concurrency=64,
-        ).remote(host, port)
-        ray.get(handle.address.remote(), timeout=60)
-        return handle
+        pass
+    else:
+        if servicer_functions:
+            # proto services register at proxy creation (grpc handlers
+            # are fixed before server start): silently dropping them on
+            # reuse would leave every proto method UNIMPLEMENTED
+            raise ValueError(
+                "the gRPC proxy is already running; pass "
+                "grpc_servicer_functions on the FIRST serve.run that "
+                "opens the gRPC port (or serve.shutdown() first)")
+        return existing
+    Proxy = ray.remote(GrpcProxyActor)
+    handle = Proxy.options(
+        name=_GRPC_PROXY_NAME, lifetime="detached", max_concurrency=64,
+    ).remote(host, port, tuple(servicer_functions))
+    ray.get(handle.address.remote(), timeout=60)
+    return handle
 
 
 def run(
@@ -125,6 +137,7 @@ def run(
     http_host: str = "127.0.0.1",
     http_port: int = 8000,
     grpc_port: Optional[int] = None,
+    grpc_servicer_functions: tuple = (),
     blocking: bool = False,
     _http: bool = True,
 ) -> DeploymentHandle:
@@ -176,7 +189,8 @@ def run(
     if grpc_port is not None:
         # second ingress (reference runs HTTP + gRPC proxies side by
         # side, proxy.py:520): same routing table, same handles
-        gproxy = _get_or_create_grpc_proxy(http_host, grpc_port)
+        gproxy = _get_or_create_grpc_proxy(
+            http_host, grpc_port, grpc_servicer_functions)
         ray.get(gproxy.update_routes.remote(routes=routes), timeout=30)
 
     handle = DeploymentHandle(dep.name)

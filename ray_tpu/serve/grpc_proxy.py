@@ -28,10 +28,38 @@ LIST_APPS = "/ray_tpu.serve.RayServeAPIService/ListApplications"
 ROUTE = "/ray_tpu.serve.GenericService/Route"
 
 
+class _CapturingServer:
+    """Stand-in ``server`` handed to a generated
+    ``add_<Service>Servicer_to_server`` function: records the generic
+    handlers (and, on newer grpcio, the per-method handler dicts) the
+    generated code registers, so the proxy learns every method's
+    streaming flags and proto serializers WITHOUT compiling the user's
+    proto itself."""
+
+    def __init__(self):
+        self.generic_handlers: list = []
+        self.method_handlers: Dict[str, Any] = {}  # full name -> handler
+
+    def add_generic_rpc_handlers(self, handlers):
+        self.generic_handlers.extend(handlers)
+        for h in handlers:
+            # grpc's DictionaryGenericHandler keeps the per-method dict;
+            # read it to learn streaming flags (private attr, stable
+            # across grpcio releases; best-effort)
+            mh = getattr(h, "_method_handlers", None)
+            if isinstance(mh, dict):
+                self.method_handlers.update(mh)
+
+    def add_registered_method_handlers(self, service_name, method_handlers):
+        for name, h in (method_handlers or {}).items():
+            self.method_handlers[f"/{service_name}/{name}"] = h
+
+
 class GrpcProxyActor:
     """One gRPC server actor fronting every deployment (data plane)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000,
+                 servicer_functions: tuple = ()):
         import grpc
 
         self.host = host
@@ -60,11 +88,128 @@ class GrpcProxyActor:
                 max_workers=32, thread_name_prefix="grpc-proxy"),
             handlers=(_Handler(),),
         )
+        # user-defined proto services (reference: gRPCOptions.
+        # grpc_servicer_functions — generated add_*_servicer_to_server
+        # paths/callables): each method routes to a deployment, which
+        # receives the DESERIALIZED request proto and returns the
+        # response proto; the generated serializers do the wire work.
+        for fn in servicer_functions:
+            self._add_proto_service(fn)
         bound = self._server.add_insecure_port(f"{host}:{port}")
         if bound == 0:
             raise OSError(f"could not bind gRPC proxy on {host}:{port}")
         self.port = bound
         self._server.start()
+
+    def _add_proto_service(self, adder):
+        """Register a user proto service through its generated adder."""
+        if isinstance(adder, str):
+            import importlib
+
+            mod, _, attr = adder.replace(":", ".").rpartition(".")
+            adder = getattr(importlib.import_module(mod), attr)
+        outer = self
+        # per-SERVICE streaming flags: the closures below consult this
+        # dict at call time (it fills after the adder runs), and each
+        # adder gets its own — two services sharing a method name can't
+        # clobber each other's flags
+        stream_flags: Dict[str, bool] = {}
+
+        class _RoutingServicer:
+            """Every proto method resolves to a deployment call."""
+
+            def __getattr__(self, method_name):
+                def call(request, context):
+                    return outer._route_proto(
+                        method_name, request, context,
+                        stream_flags.get(method_name, False))
+
+                return call
+
+        cap = _CapturingServer()
+        adder(_RoutingServicer(), cap)
+        for full_name, h in cap.method_handlers.items():
+            short = full_name.rsplit("/", 1)[-1]
+            stream_flags[short] = bool(
+                getattr(h, "response_streaming", False))
+            if getattr(h, "request_streaming", False):
+                raise ValueError(
+                    f"client-streaming RPC {full_name} is not supported "
+                    "(unary and server-streaming only)")
+        self._server.add_generic_rpc_handlers(
+            tuple(cap.generic_handlers))
+
+    def _route_proto(self, method_name: str, request, context,
+                     streaming: bool):
+        """Data plane for user proto methods: pick the deployment from
+        the ``application`` metadata (single deployed app = default),
+        call it with the request proto, return the response proto(s).
+        Server-streaming methods iterate a streaming handle, one proto
+        per yielded item (reference: gRPCProxy streaming responses).
+        The deployment method NAMED like the proto method serves it
+        (reference: serve gRPC matches ingress methods by name);
+        deployments exposing only __call__ fall back there."""
+        import grpc
+
+        self._num_requests += 1
+        md = dict(context.invocation_metadata() or ())
+        app = md.get("application", "")
+        target = self._routes.get(app) or (
+            app if app in self._routes.values() else None)
+        if target is None:
+            if len(set(self._routes.values())) == 1:
+                target = next(iter(self._routes.values()))
+            else:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"application metadata required (have "
+                    f"{sorted(set(self._routes.values()))})")
+                return None
+        handle = self._get_handle(target)
+        model_id = md.get("multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        try:
+            return self._call_proto_method(
+                handle, method_name, request, streaming)
+        except Exception as e:  # noqa: BLE001 — surface to the client
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+            return None
+
+    @staticmethod
+    def _call_proto_method(handle, method_name, request, streaming):
+        def attempt(name):
+            h = handle.options(method_name=name)
+            if streaming:
+                gen = iter(h.options(stream=True).remote(request))
+                # pull the first item EAGERLY so a missing method falls
+                # back to __call__ instead of erroring mid-wire
+                import itertools
+
+                try:
+                    first = next(gen)
+                except StopIteration:
+                    return iter(())
+                return itertools.chain((first,), gen)
+            return h.remote(request).result(timeout=120)
+
+        try:
+            return attempt(method_name)
+        except Exception as e:  # noqa: BLE001 — fall back only on a
+            # missing-method error; anything else is the real failure
+            if "AttributeError" in str(e) or "no method" in str(e):
+                return attempt("__call__")
+            raise
+
+    def _get_handle(self, target: str):
+        handle = self._handles.get(target)
+        if handle is None:
+            from .handle import DeploymentHandle
+
+            handle = DeploymentHandle(target)
+            self._handles[target] = handle
+        return handle
 
     # -- control methods ----------------------------------------------
     def _list_applications(self, request: bytes, context) -> bytes:
@@ -95,12 +240,7 @@ class GrpcProxyActor:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no application for {app!r}")
             return b""
-        handle = self._handles.get(target)
-        if handle is None:
-            from .handle import DeploymentHandle
-
-            handle = DeploymentHandle(target)
-            self._handles[target] = handle
+        handle = self._get_handle(target)
         model_id = body.get("multiplexed_model_id", "")
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)

@@ -1,0 +1,162 @@
+"""Protobuf-native gRPC serving (VERDICT r4 #9).
+
+Reference: serve/_private/proxy.py:520 gRPCProxy — users pass generated
+``add_<Service>Servicer_to_server`` functions (gRPCOptions.
+grpc_servicer_functions); the proxy implements every proto method by
+routing the deserialized request message to the deployment method of
+the same name and serializing the returned response message.
+Server-streaming methods ride the streaming handle.
+
+The test materializes a REAL proto module pair on disk without protoc:
+``echo_test_pb2.py`` registers the messages in the default descriptor
+pool at import (what generated code expands to), and
+``echo_test_pb2_grpc.py`` holds the adder exactly as protoc's grpc
+plugin would emit it. PYTHONPATH makes both importable in the proxy and
+replica worker processes, so request/reply protos pickle across them.
+"""
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import serve
+
+PB2 = '''
+"""Hand-rolled equivalent of protoc output for echo_test.proto."""
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_pool = descriptor_pool.Default()
+try:
+    _pool.FindFileByName("echo_test.proto")
+except KeyError:
+    _f = descriptor_pb2.FileDescriptorProto(
+        name="echo_test.proto", package="echo_test", syntax="proto3")
+    _req = _f.message_type.add(name="EchoRequest")
+    _req.field.add(name="text", number=1,
+                   type=descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+                   label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL)
+    _req.field.add(name="repeat", number=2,
+                   type=descriptor_pb2.FieldDescriptorProto.TYPE_INT32,
+                   label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL)
+    _rep = _f.message_type.add(name="EchoReply")
+    _rep.field.add(name="text", number=1,
+                   type=descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+                   label=descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL)
+    _pool.Add(_f)
+
+EchoRequest = message_factory.GetMessageClass(
+    _pool.FindMessageTypeByName("echo_test.EchoRequest"))
+EchoReply = message_factory.GetMessageClass(
+    _pool.FindMessageTypeByName("echo_test.EchoReply"))
+'''
+
+PB2_GRPC = '''
+"""Hand-rolled equivalent of protoc-grpc plugin output."""
+import grpc
+
+import echo_test_pb2 as pb2
+
+
+def add_EchoServiceServicer_to_server(servicer, server):
+    rpc_method_handlers = {
+        "Echo": grpc.unary_unary_rpc_method_handler(
+            servicer.Echo,
+            request_deserializer=pb2.EchoRequest.FromString,
+            response_serializer=pb2.EchoReply.SerializeToString,
+        ),
+        "EchoStream": grpc.unary_stream_rpc_method_handler(
+            servicer.EchoStream,
+            request_deserializer=pb2.EchoRequest.FromString,
+            response_serializer=pb2.EchoReply.SerializeToString,
+        ),
+    }
+    generic_handler = grpc.method_handlers_generic_handler(
+        "echo_test.EchoService", rpc_method_handlers)
+    server.add_generic_rpc_handlers((generic_handler,))
+'''
+
+
+def _echo_deployment_cls():
+    class EchoService:
+        def Echo(self, request):
+            import echo_test_pb2 as pb2
+
+            return pb2.EchoReply(text=request.text.upper())
+
+        def EchoStream(self, request):
+            import echo_test_pb2 as pb2
+
+            for i in range(max(1, request.repeat)):
+                yield pb2.EchoReply(text=f"{request.text}-{i}")
+
+    return EchoService
+
+
+@pytest.fixture(scope="module")
+def grpc_app(tmp_path_factory):
+    moddir = str(tmp_path_factory.mktemp("protomod"))
+    with open(os.path.join(moddir, "echo_test_pb2.py"), "w") as f:
+        f.write(textwrap.dedent(PB2))
+    with open(os.path.join(moddir, "echo_test_pb2_grpc.py"), "w") as f:
+        f.write(textwrap.dedent(PB2_GRPC))
+    sys.path.insert(0, moddir)
+    # worker processes (proxy actor, replicas) inherit the raylet's env:
+    # PYTHONPATH makes the proto modules importable everywhere
+    old_pp = os.environ.get("PYTHONPATH", "")
+    os.environ["PYTHONPATH"] = moddir + (os.pathsep + old_pp
+                                         if old_pp else "")
+    ray.init(resources={"CPU": 8, "memory": 10**9})
+    serve.run(
+        serve.deployment(_echo_deployment_cls()).bind(),
+        grpc_port=19750, _http=False,
+        grpc_servicer_functions=(
+            "echo_test_pb2_grpc.add_EchoServiceServicer_to_server",),
+    )
+    import echo_test_pb2 as pb2
+
+    yield pb2
+    serve.shutdown()
+    ray.shutdown()
+    sys.path.remove(moddir)
+    os.environ["PYTHONPATH"] = old_pp
+
+
+def test_custom_proto_unary(grpc_app):
+    import grpc
+
+    pb2 = grpc_app
+    with grpc.insecure_channel("127.0.0.1:19750") as ch:
+        fn = ch.unary_unary(
+            "/echo_test.EchoService/Echo",
+            request_serializer=pb2.EchoRequest.SerializeToString,
+            response_deserializer=pb2.EchoReply.FromString,
+        )
+        reply = fn(pb2.EchoRequest(text="hello proto"), timeout=120)
+    assert reply.text == "HELLO PROTO"
+
+
+def test_custom_proto_server_streaming(grpc_app):
+    import grpc
+
+    pb2 = grpc_app
+    with grpc.insecure_channel("127.0.0.1:19750") as ch:
+        fn = ch.unary_stream(
+            "/echo_test.EchoService/EchoStream",
+            request_serializer=pb2.EchoRequest.SerializeToString,
+            response_deserializer=pb2.EchoReply.FromString,
+        )
+        replies = list(fn(pb2.EchoRequest(text="tok", repeat=4),
+                          timeout=120))
+    assert [r.text for r in replies] == [
+        "tok-0", "tok-1", "tok-2", "tok-3"]
+
+
+def test_generic_healthz_still_served(grpc_app):
+    import grpc
+
+    with grpc.insecure_channel("127.0.0.1:19750") as ch:
+        fn = ch.unary_unary(
+            "/ray_tpu.serve.RayServeAPIService/Healthz")
+        assert fn(b"", timeout=60) == b"ok"

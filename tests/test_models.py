@@ -143,3 +143,33 @@ def test_presets():
     assert LlamaConfig.llama3_8b().num_params() > 7e9
     assert LlamaConfig.llama3_70b().num_params() > 60e9
     assert LlamaConfig.llama2_7b().num_params() > 6e9
+
+
+def test_chunked_ce_matches_dense():
+    """ce_chunk>0 must give the same loss AND gradients as the dense
+    [B,S,vocab] path (it only changes materialization, not math)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, init_params, loss_fn
+
+    cfg = LlamaConfig.tiny(dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                           vocab_size=256, max_seq_len=64)
+    cfg_c = dataclasses.replace(cfg, ce_chunk=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 33)), dtype=jnp.int32)
+    l0, g0 = jax.value_and_grad(
+        lambda p: loss_fn(cfg, p, tokens))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: loss_fn(cfg_c, p, tokens))(params)
+    assert np.allclose(float(l0), float(l1), rtol=1e-5), (l0, l1)
+    flat0 = jax.tree_util.tree_leaves(g0)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    for a, b in zip(flat0, flat1):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=2e-5)
