@@ -22,7 +22,8 @@ from .config import AlgorithmConfig
 from .algorithm import Algorithm
 from .algorithms import (PPO, PPOConfig, DQN, DQNConfig, SAC,
                          SACConfig, IMPALA, IMPALAConfig,
-                         BC, BCConfig, MARWIL, MARWILConfig)
+                         BC, BCConfig, MARWIL, MARWILConfig,
+                         CQL, CQLConfig)
 from . import offline
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                           MultiAgentPPO, IndependentCartPoles)
@@ -30,7 +31,7 @@ from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
 __all__ = [
     "Box", "Discrete", "Env", "VectorEnv", "register_env", "make_env",
     "SampleBatch", "ActorCriticModule", "QModule", "EnvRunner",
-    "BC", "BCConfig", "MARWIL", "MARWILConfig", "offline",
+    "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "offline",
     "Learner", "LearnerGroup", "AlgorithmConfig", "Algorithm",
     "PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
     "IMPALA", "IMPALAConfig", "MultiAgentEnv", "MultiAgentEnvRunner",

@@ -3,6 +3,7 @@ from .dqn import DQN, DQNConfig
 from .sac import SAC, SACConfig
 from .impala import IMPALA, IMPALAConfig
 from .marwil import BC, BCConfig, MARWIL, MARWILConfig
+from .cql import CQL, CQLConfig
 
 __all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
-           "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig"]
+           "IMPALA", "IMPALAConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig"]

@@ -1,0 +1,142 @@
+"""CQL: conservative Q-learning from logged data (offline).
+
+Reference: rllib/algorithms/cql/cql.py (CQLConfig — SAC + the
+conservative penalty, offline-first) and the CQL paper's discrete form:
+alongside the TD loss, penalize the soft-maximum of Q over ALL actions
+relative to Q of the logged action,
+
+    L = TD + alpha * E[ logsumexp_a Q(s, a) - Q(s, a_data) ],
+
+which pushes Q down on out-of-distribution actions so the greedy policy
+stays inside the dataset's support — the failure mode plain offline
+Q-learning has. TPU-first: the whole update (double-Q TD target +
+penalty + optimizer) is one jitted call; the target net is learner
+state synced every N updates.
+
+Like BC/MARWIL, training touches only the DatasetReader; the env exists
+for spaces and evaluation.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..env import make_env
+from ..learner import Learner
+from ..rl_module import QModule
+from ..sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+from .marwil import MARWIL, MARWILConfig
+
+
+class CQLConfig(MARWILConfig):
+    def __init__(self):
+        super().__init__()
+        self.cql_alpha = 0.5
+        self.target_update_freq = 100  # gradient updates between syncs
+        self.lr = 5e-4
+
+    @property
+    def algo_class(self):
+        return CQL
+
+
+class CQLLearner(Learner):
+    """One jitted update: double-Q TD target from the target net, the
+    conservative logsumexp penalty, optimizer step. `target_params` and
+    the update counter ride learner state (checkpointed)."""
+
+    def __init__(self, module, config, seed: int = 0):
+        super().__init__(module, config, seed)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self._updates = 0
+        self._update_jit = jax.jit(partial(
+            self._update_impl,
+            gamma=config.get("gamma", 0.99),
+            alpha=config.get("cql_alpha", 1.0),
+        ))
+
+    def _update_impl(self, params, target_params, opt_state, batch, *,
+                     gamma, alpha):
+        obs = batch[OBS]
+        actions = batch[ACTIONS].astype(jnp.int32)
+        rewards = batch[REWARDS]
+        dones = batch[DONES].astype(jnp.float32)
+        next_obs = batch[NEXT_OBS]
+
+        # double-Q: online net picks the argmax, target net evaluates it
+        next_a = jnp.argmax(self.module.q_values(params, next_obs),
+                            axis=-1)
+        next_q = self.module.q_values(target_params, next_obs)[
+            jnp.arange(next_a.shape[0]), next_a]
+        target = rewards + gamma * (1.0 - dones) * \
+            jax.lax.stop_gradient(next_q)
+
+        def loss_fn(p):
+            q_all = self.module.q_values(p, obs)
+            q_data = q_all[jnp.arange(actions.shape[0]), actions]
+            td = jnp.mean((q_data - target) ** 2)
+            # the conservative penalty: soft-max over ALL actions minus
+            # the logged action's value
+            cql = jnp.mean(
+                jax.scipy.special.logsumexp(q_all, axis=-1) - q_data)
+            return td + alpha * cql, (td, cql, jnp.mean(q_data))
+
+        (loss, (td, cql, q_mean)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {
+            "total_loss": loss, "td_loss": td, "cql_penalty": cql,
+            "q_mean": q_mean,
+        }
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        dev = {
+            OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
+            ACTIONS: jnp.asarray(np.asarray(batch[ACTIONS])),
+            REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
+            DONES: jnp.asarray(np.asarray(batch[DONES])),
+            NEXT_OBS: jnp.asarray(
+                np.asarray(batch[NEXT_OBS], np.float32)),
+        }
+        self.params, self.opt_state, stats = self._update_jit(
+            self.params, self.target_params, self.opt_state, dev)
+        self._updates += 1
+        if self._updates % int(self.config.get(
+                "target_update_freq", 200)) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state: dict) -> bool:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.device_put(state["target_params"])
+        self._updates = int(state.get("updates", 0))
+        return True
+
+
+class CQL(MARWIL):
+    """Offline driver shape inherited from MARWIL (dataset reader, zero
+    env steps); the module is a Q-net, evaluation is greedy argmax —
+    the same EnvRunner path DQN uses."""
+
+    learner_cls = CQLLearner
+
+    def _build_module(self):
+        probe = make_env(self.config.env, **self.config.env_config)
+        return QModule(probe.observation_space, probe.action_space,
+                       hiddens=self.config.hiddens)

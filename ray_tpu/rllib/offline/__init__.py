@@ -19,7 +19,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..sample_batch import ACTIONS, DONES, OBS, REWARDS, SampleBatch
+from ..sample_batch import (ACTIONS, DONES, NEXT_OBS, OBS, REWARDS,
+                            SampleBatch)
 
 RETURNS = "returns"  # reward-to-go column added by the reader
 
@@ -110,6 +111,8 @@ class DatasetReader:
             raise ValueError(f"no episodes in {paths}")
         cols: Dict[str, List] = {
             OBS: [], ACTIONS: [], REWARDS: [], DONES: [], RETURNS: []}
+        next_idx: List[np.ndarray] = []  # successor row per transition
+        base = 0
         n_eps = 0
         ep_returns: List[float] = []
         for row in rows:
@@ -120,14 +123,25 @@ class DatasetReader:
             for t in range(len(r) - 1, -1, -1):
                 acc = r[t] + gamma * acc
                 rtg[t] = acc
-            cols[OBS].append(np.asarray(row["obs"], np.float32))
+            obs = np.asarray(row["obs"], np.float32)
+            cols[OBS].append(obs)
             cols[ACTIONS].append(np.asarray(row["actions"]))
             cols[REWARDS].append(r)
             cols[DONES].append(np.asarray(row["dones"], bool))
             cols[RETURNS].append(rtg)
+            # successor-row index per transition (terminal rows point
+            # at themselves; dones masks their bootstrap): next_obs is
+            # DERIVED per minibatch instead of materializing a second
+            # full copy of the observations — TD algorithms (CQL) pay
+            # only batch-sized gathers, BC/MARWIL pay nothing
+            T = len(r)
+            idxs = base + np.minimum(np.arange(1, T + 1), T - 1)
+            next_idx.append(idxs)
+            base += T
             n_eps += 1
             ep_returns.append(float(r.sum()))
         self._cols = {k: np.concatenate(v) for k, v in cols.items()}
+        self._next_idx = np.concatenate(next_idx)
         self.num_episodes = n_eps
         self.num_transitions = len(self._cols[REWARDS])
         self.mean_episode_return = float(np.mean(ep_returns))
@@ -135,7 +149,11 @@ class DatasetReader:
 
     def next_batch(self, n: int) -> SampleBatch:
         idx = self._rng.integers(0, self.num_transitions, size=n)
-        return SampleBatch({k: v[idx] for k, v in self._cols.items()})
+        out = {k: v[idx] for k, v in self._cols.items()}
+        out[NEXT_OBS] = self._cols[OBS][self._next_idx[idx]]
+        return SampleBatch(out)
 
     def as_batch(self) -> SampleBatch:
-        return SampleBatch(dict(self._cols))
+        out = dict(self._cols)
+        out[NEXT_OBS] = self._cols[OBS][self._next_idx]
+        return SampleBatch(out)

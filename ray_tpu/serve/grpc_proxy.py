@@ -197,8 +197,13 @@ class GrpcProxyActor:
         try:
             return attempt(method_name)
         except Exception as e:  # noqa: BLE001 — fall back only on a
-            # missing-method error; anything else is the real failure
-            if "AttributeError" in str(e) or "no method" in str(e):
+            # missing-METHOD error (the replica's getattr failing on
+            # this exact name); an AttributeError raised INSIDE an
+            # existing method is the real failure and must surface,
+            # not silently re-execute the request on __call__
+            msg = str(e)
+            if (f"has no attribute '{method_name}'" in msg
+                    or f"no method {method_name!r}" in msg):
                 return attempt("__call__")
             raise
 
