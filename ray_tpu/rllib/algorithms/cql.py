@@ -27,9 +27,10 @@ import numpy as np
 import optax
 
 from ..env import make_env
-from ..learner import Learner
+from ..learner import Learner, TargetNetworkMixin
 from ..rl_module import QModule
-from ..sample_batch import ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch
+from ..offline import BOOTSTRAP_MASK
+from ..sample_batch import ACTIONS, NEXT_OBS, OBS, REWARDS, SampleBatch
 from .marwil import MARWIL, MARWILConfig
 
 
@@ -45,20 +46,20 @@ class CQLConfig(MARWILConfig):
         return CQL
 
 
-class CQLLearner(Learner):
+class CQLLearner(TargetNetworkMixin, Learner):
     """One jitted update: double-Q TD target from the target net, the
     conservative logsumexp penalty, optimizer step. `target_params` and
-    the update counter ride learner state (checkpointed)."""
+    the update counter ride learner state (checkpointed; shared
+    TargetNetworkMixin plumbing with DQN)."""
 
     def __init__(self, module, config, seed: int = 0):
         super().__init__(module, config, seed)
-        self.target_params = jax.tree_util.tree_map(
-            jnp.copy, self.params)
-        self._updates = 0
+        self._init_target_network()
         self._update_jit = jax.jit(partial(
             self._update_impl,
             gamma=config.get("gamma", 0.99),
-            alpha=config.get("cql_alpha", 1.0),
+            # fallbacks mirror CQLConfig's declared defaults
+            alpha=config.get("cql_alpha", 0.5),
         ))
 
     def _update_impl(self, params, target_params, opt_state, batch, *,
@@ -66,15 +67,17 @@ class CQLLearner(Learner):
         obs = batch[OBS]
         actions = batch[ACTIONS].astype(jnp.int32)
         rewards = batch[REWARDS]
-        dones = batch[DONES].astype(jnp.float32)
+        bootstrap = batch[BOOTSTRAP_MASK]
         next_obs = batch[NEXT_OBS]
 
-        # double-Q: online net picks the argmax, target net evaluates it
+        # double-Q: online net picks the argmax, target net evaluates
+        # it. The reader's bootstrap mask is 0 on terminal rows AND on
+        # truncated episode tails (whose next_obs self-points).
         next_a = jnp.argmax(self.module.q_values(params, next_obs),
                             axis=-1)
         next_q = self.module.q_values(target_params, next_obs)[
             jnp.arange(next_a.shape[0]), next_a]
-        target = rewards + gamma * (1.0 - dones) * \
+        target = rewards + gamma * bootstrap * \
             jax.lax.stop_gradient(next_q)
 
         def loss_fn(p):
@@ -102,31 +105,15 @@ class CQLLearner(Learner):
             OBS: jnp.asarray(np.asarray(batch[OBS], np.float32)),
             ACTIONS: jnp.asarray(np.asarray(batch[ACTIONS])),
             REWARDS: jnp.asarray(np.asarray(batch[REWARDS], np.float32)),
-            DONES: jnp.asarray(np.asarray(batch[DONES])),
+            BOOTSTRAP_MASK: jnp.asarray(
+                np.asarray(batch[BOOTSTRAP_MASK], np.float32)),
             NEXT_OBS: jnp.asarray(
                 np.asarray(batch[NEXT_OBS], np.float32)),
         }
         self.params, self.opt_state, stats = self._update_jit(
             self.params, self.target_params, self.opt_state, dev)
-        self._updates += 1
-        if self._updates % int(self.config.get(
-                "target_update_freq", 200)) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
+        self._count_update_maybe_sync(100)
         return {k: float(v) for k, v in stats.items()}
-
-    def get_state(self) -> dict:
-        state = super().get_state()
-        state["target_params"] = jax.device_get(self.target_params)
-        state["updates"] = self._updates
-        return state
-
-    def set_state(self, state: dict) -> bool:
-        super().set_state(state)
-        if "target_params" in state:
-            self.target_params = jax.device_put(state["target_params"])
-        self._updates = int(state.get("updates", 0))
-        return True
 
 
 class CQL(MARWIL):
@@ -135,6 +122,7 @@ class CQL(MARWIL):
     the same EnvRunner path DQN uses."""
 
     learner_cls = CQLLearner
+    _needs_next_obs = True  # TD algorithm: reader gathers next_obs
 
     def _build_module(self):
         probe = make_env(self.config.env, **self.config.env_config)

@@ -17,7 +17,7 @@ import optax
 from ..algorithm import Algorithm
 from ..config import AlgorithmConfig
 from ..env import make_env
-from ..learner import Learner
+from ..learner import Learner, TargetNetworkMixin
 from ..rl_module import QModule
 from ..sample_batch import (
     ACTIONS, DONES, NEXT_OBS, OBS, REWARDS, SampleBatch,
@@ -94,7 +94,7 @@ class ReplayBuffer:
         }
 
 
-class DQNLearner(Learner):
+class DQNLearner(TargetNetworkMixin, Learner):
     def __init__(self, module, config, seed: int = 0):
         super().__init__(module, config, seed)
         self.optimizer = optax.chain(
@@ -102,12 +102,10 @@ class DQNLearner(Learner):
             optax.adam(config.get("lr", 1e-3)),
         )
         self.opt_state = self.optimizer.init(self.params)
-        self.target_params = jax.tree_util.tree_map(
-            jnp.copy, self.params)
+        self._init_target_network()
         self.buffer = ReplayBuffer(
             config.get("buffer_size", 50_000), module.obs_dim)
         self._rng = np.random.default_rng(seed)
-        self._updates = 0
         gamma = config.get("gamma", 0.99)
 
         def td_step(params, opt_state, target_params, mb):
@@ -147,11 +145,7 @@ class DQNLearner(Learner):
                   self.buffer.sample(self._rng, bs).items()}
             self.params, self.opt_state, loss = self._td_jit(
                 self.params, self.opt_state, self.target_params, mb)
-            self._updates += 1
-            if self._updates % self.config.get(
-                    "target_update_freq", 500) == 0:
-                self.target_params = jax.tree_util.tree_map(
-                    jnp.copy, self.params)
+            self._count_update_maybe_sync(500)
         self._metrics = {"td_loss": float(loss),
                          "buffer_size": float(self.buffer.size),
                          "num_updates": float(self._updates)}
@@ -183,26 +177,8 @@ class DQNLearner(Learner):
 
         loss, grads = jax.value_and_grad(loss_fn)(self.params)
         self._metrics = {"td_loss": float(loss)}
-        self._updates += 1
-        if self._updates % self.config.get(
-                "target_update_freq", 500) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
+        self._count_update_maybe_sync(500)
         return jax.device_get(grads)
-
-    def get_state(self) -> dict:
-        state = super().get_state()
-        state["target_params"] = jax.device_get(self.target_params)
-        state["updates"] = self._updates
-        return state
-
-    def set_state(self, state: dict) -> bool:
-        super().set_state(state)
-        if "target_params" in state:
-            self.target_params = jax.device_put(state["target_params"])
-        self._updates = state.get("updates", 0)
-        return True
-
 
 class DQN(Algorithm):
     learner_cls = DQNLearner

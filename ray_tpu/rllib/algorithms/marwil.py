@@ -139,6 +139,9 @@ class MARWIL(Algorithm):
     dataset; num_env_steps_sampled stays 0)."""
 
     learner_cls = MARWILLearner
+    # TD subclasses (CQL) set True: the reader then gathers next_obs +
+    # bootstrap mask per batch
+    _needs_next_obs = False
 
     def __init__(self, config: "MARWILConfig"):
         if not getattr(config, "input_", None):
@@ -165,7 +168,9 @@ class MARWIL(Algorithm):
         import time
 
         t0 = time.monotonic()
-        batch = self._reader.next_batch(self.config.train_batch_size)
+        batch = self._reader.next_batch(
+            self.config.train_batch_size,
+            with_next_obs=self._needs_next_obs)
         learn = self.training_step(batch)
         self.iteration += 1
         return {

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
@@ -164,3 +165,35 @@ class LearnerGroup:
         return ray.get([
             getattr(a, method).remote(*args) for a in self._actors
         ])
+
+
+class TargetNetworkMixin:
+    """Target-network plumbing shared by TD learners (DQN, CQL):
+    a frozen copy of the online params, synced every
+    ``target_update_freq`` gradient updates, carried through checkpoint
+    state. Mix in BEFORE Learner so get/set_state chain correctly."""
+
+    def _init_target_network(self):
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self._updates = 0
+
+    def _count_update_maybe_sync(self, default_freq: int):
+        self._updates += 1
+        if self._updates % int(self.config.get(
+                "target_update_freq", default_freq)) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["updates"] = self._updates
+        return state
+
+    def set_state(self, state: dict) -> bool:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.device_put(state["target_params"])
+        self._updates = int(state.get("updates", 0))
+        return True

@@ -23,6 +23,10 @@ from ..sample_batch import (ACTIONS, DONES, NEXT_OBS, OBS, REWARDS,
                             SampleBatch)
 
 RETURNS = "returns"  # reward-to-go column added by the reader
+# 1.0 where TD algorithms may bootstrap from next_obs; 0.0 on terminal
+# rows AND on truncated episode tails (their next_obs self-points, so
+# bootstrapping there would be self-referential)
+BOOTSTRAP_MASK = "bootstrap_mask"
 
 
 def write_episodes(episodes: List[dict], path: str,
@@ -112,6 +116,7 @@ class DatasetReader:
         cols: Dict[str, List] = {
             OBS: [], ACTIONS: [], REWARDS: [], DONES: [], RETURNS: []}
         next_idx: List[np.ndarray] = []  # successor row per transition
+        boot_mask: List[np.ndarray] = []
         base = 0
         n_eps = 0
         ep_returns: List[float] = []
@@ -137,23 +142,33 @@ class DatasetReader:
             T = len(r)
             idxs = base + np.minimum(np.arange(1, T + 1), T - 1)
             next_idx.append(idxs)
+            mask = (~np.asarray(row["dones"], bool)).astype(np.float32)
+            mask[-1] = 0.0  # truncated tail: next_obs self-points
+            boot_mask.append(mask)
             base += T
             n_eps += 1
             ep_returns.append(float(r.sum()))
         self._cols = {k: np.concatenate(v) for k, v in cols.items()}
         self._next_idx = np.concatenate(next_idx)
+        self._boot_mask = np.concatenate(boot_mask)
         self.num_episodes = n_eps
         self.num_transitions = len(self._cols[REWARDS])
         self.mean_episode_return = float(np.mean(ep_returns))
         self._rng = np.random.default_rng(seed)
 
-    def next_batch(self, n: int) -> SampleBatch:
+    def next_batch(self, n: int,
+                   with_next_obs: bool = False) -> SampleBatch:
+        """``with_next_obs``: TD algorithms opt in; BC/MARWIL skip the
+        batch-sized observation gather they would never read."""
         idx = self._rng.integers(0, self.num_transitions, size=n)
         out = {k: v[idx] for k, v in self._cols.items()}
-        out[NEXT_OBS] = self._cols[OBS][self._next_idx[idx]]
+        if with_next_obs:
+            out[NEXT_OBS] = self._cols[OBS][self._next_idx[idx]]
+            out[BOOTSTRAP_MASK] = self._boot_mask[idx]
         return SampleBatch(out)
 
     def as_batch(self) -> SampleBatch:
         out = dict(self._cols)
         out[NEXT_OBS] = self._cols[OBS][self._next_idx]
+        out[BOOTSTRAP_MASK] = self._boot_mask
         return SampleBatch(out)

@@ -201,9 +201,11 @@ class GrpcProxyActor:
             # this exact name); an AttributeError raised INSIDE an
             # existing method is the real failure and must surface,
             # not silently re-execute the request on __call__
-            msg = str(e)
-            if (f"has no attribute '{method_name}'" in msg
-                    or f"no method {method_name!r}" in msg):
+            # the replica raises a SENTINEL phrase for a missing
+            # method (replica.py); an AttributeError raised INSIDE an
+            # existing method body cannot produce it, so it surfaces
+            if f"serve deployment has no method {method_name!r}" \
+                    in str(e):
                 return attempt("__call__")
             raise
 

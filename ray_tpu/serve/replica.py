@@ -48,7 +48,13 @@ class ReplicaActor:
             self._total += 1
         _set_model_id(multiplexed_model_id)
         try:
-            target = getattr(self.user, method)
+            target = getattr(self.user, method, None)
+            if target is None:
+                # SENTINEL text the gRPC proxy matches for its
+                # __call__ fallback — user-code AttributeErrors from
+                # inside a method can never produce this phrase
+                raise AttributeError(
+                    f"serve deployment has no method {method!r}")
             if inspect.iscoroutinefunction(target):
                 return await target(*args, **kwargs)
             loop = asyncio.get_running_loop()
@@ -78,7 +84,10 @@ class ReplicaActor:
             self._total += 1
         _set_model_id(multiplexed_model_id)
         try:
-            target = getattr(self.user, method)
+            target = getattr(self.user, method, None)
+            if target is None:
+                raise AttributeError(
+                    f"serve deployment has no method {method!r}")
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result

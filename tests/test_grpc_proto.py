@@ -160,3 +160,61 @@ def test_generic_healthz_still_served(grpc_app):
         fn = ch.unary_unary(
             "/ray_tpu.serve.RayServeAPIService/Healthz")
         assert fn(b"", timeout=60) == b"ok"
+
+
+def test_call_proto_method_fallback_unit():
+    """_call_proto_method falls back to __call__ ONLY on the replica's
+    missing-method getattr failure; an AttributeError raised inside an
+    existing method surfaces (no silent double execution)."""
+    from ray_tpu.serve.grpc_proxy import GrpcProxyActor
+
+    class FakeFuture:
+        def __init__(self, value=None, exc=None):
+            self._value, self._exc = value, exc
+
+        def result(self, timeout=None):
+            if self._exc:
+                raise self._exc
+            return self._value
+
+    class FakeHandle:
+        def __init__(self, methods, calls):
+            self._methods = methods  # name -> value or Exception
+            self._calls = calls
+            self._name = None
+
+        def options(self, **kw):
+            if "method_name" in kw:
+                self._name = kw["method_name"]
+            return self
+
+        def remote(self, request):
+            self._calls.append(self._name)
+            out = self._methods.get(self._name)
+            if out is None:
+                return FakeFuture(exc=RuntimeError(
+                    f"AttributeError: serve deployment has no method "
+                    f"'{self._name}'"))
+            if isinstance(out, Exception):
+                return FakeFuture(exc=out)
+            return FakeFuture(value=out)
+
+    # missing method -> falls back to __call__
+    calls = []
+    h = FakeHandle({"__call__": "fell-back"}, calls)
+    out = GrpcProxyActor._call_proto_method(h, "Echo", object(), False)
+    assert out == "fell-back"
+    assert calls == ["Echo", "__call__"]
+
+    # AttributeError INSIDE an existing method -> surfaces, no retry
+    calls = []
+    h = FakeHandle(
+        {"Echo": RuntimeError(
+            "AttributeError: 'EchoRequest' object has no attribute "
+            "'txt'"),
+         "__call__": "should-not-run"},
+        calls,
+    )
+    with pytest.raises(RuntimeError, match="'txt'"):
+        GrpcProxyActor._call_proto_method(h, "Echo", object(), False)
+    assert calls == ["Echo"]
