@@ -180,6 +180,7 @@ class DQNLearner(TargetNetworkMixin, Learner):
         self._count_update_maybe_sync(500)
         return jax.device_get(grads)
 
+
 class DQN(Algorithm):
     learner_cls = DQNLearner
 

@@ -169,7 +169,27 @@ class ServeController:
                     rep["started"] = True
                     rep["health_fails"] = 0
                     alive.append(rep)
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — classified below
+                    # TERMINAL death (the GCS marked the actor dead —
+                    # crashed process, not a slow boot or stall) can
+                    # never recover: replace immediately. Without this,
+                    # a replica that dies BEFORE its first successful
+                    # probe hides behind the startup grace for its full
+                    # duration (reference: deployment_state reacts to
+                    # the actor-death signal, not just probe failures).
+                    # match only the TERMINAL messages ("actor is
+                    # dead", "actor died: <cause>") — RayActorError is
+                    # also raised for transient transport failures,
+                    # which must keep going through grace/3-strike
+                    msg = str(e)
+                    actor_dead = ("actor is dead" in msg
+                                  or "actor died:" in msg)
+                    if actor_dead:
+                        try:
+                            ray.kill(rep["handle"])
+                        except Exception:
+                            pass
+                        continue  # dropped: replacement spawns below
                     if not rep.get("started") and (
                             now - rep["created_at"] < grace):
                         # throttle the re-probe too: without this a

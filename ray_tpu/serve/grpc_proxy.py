@@ -202,10 +202,12 @@ class GrpcProxyActor:
             # existing method is the real failure and must surface,
             # not silently re-execute the request on __call__
             # the replica raises a SENTINEL phrase for a missing
-            # method (replica.py); an AttributeError raised INSIDE an
-            # existing method body cannot produce it, so it surfaces
-            if f"serve deployment has no method {method_name!r}" \
-                    in str(e):
+            # method (replica.NO_METHOD_SENTINEL); an AttributeError
+            # raised INSIDE an existing method body cannot produce it,
+            # so it surfaces
+            from .replica import NO_METHOD_SENTINEL
+
+            if NO_METHOD_SENTINEL.format(method_name) in str(e):
                 return attempt("__call__")
             raise
 

@@ -15,6 +15,18 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+# Load-bearing sentinel: the gRPC proxy's __call__ fallback matches this
+# exact phrase (grpc_proxy._call_proto_method); user-code AttributeErrors
+# raised inside a method can never produce it.
+NO_METHOD_SENTINEL = "serve deployment has no method {!r}"
+
+
+def _resolve_method(user, method: str):
+    target = getattr(user, method, None)
+    if target is None:
+        raise AttributeError(NO_METHOD_SENTINEL.format(method))
+    return target
+
 
 class ReplicaActor:
     def __init__(self, serialized_cls: bytes, init_args: bytes,
@@ -48,13 +60,7 @@ class ReplicaActor:
             self._total += 1
         _set_model_id(multiplexed_model_id)
         try:
-            target = getattr(self.user, method, None)
-            if target is None:
-                # SENTINEL text the gRPC proxy matches for its
-                # __call__ fallback — user-code AttributeErrors from
-                # inside a method can never produce this phrase
-                raise AttributeError(
-                    f"serve deployment has no method {method!r}")
+            target = _resolve_method(self.user, method)
             if inspect.iscoroutinefunction(target):
                 return await target(*args, **kwargs)
             loop = asyncio.get_running_loop()
@@ -84,10 +90,7 @@ class ReplicaActor:
             self._total += 1
         _set_model_id(multiplexed_model_id)
         try:
-            target = getattr(self.user, method, None)
-            if target is None:
-                raise AttributeError(
-                    f"serve deployment has no method {method!r}")
+            target = _resolve_method(self.user, method)
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result

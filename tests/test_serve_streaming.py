@@ -251,3 +251,12 @@ def test_http_sse_incremental(serve_start):
     # production time after it); a buffered-at-once response would give
     # a near-zero spread
     assert arrive[-1] - arrive[0] > 0.3, arrive
+
+
+def test_method_access_preserves_stream_option(serve_start):
+    """handle.options(stream=True).agen.remote(...) must stream:
+    __getattr__ carries the stream/model-id options forward."""
+    handle = serve.run(
+        serve.deployment(_async_streamer_cls()).bind(), _http=False)
+    gen = handle.options(stream=True).agen.remote(3)
+    assert list(gen) == [0, 10, 20]
